@@ -1,0 +1,88 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A from-scratch re-architecture of the reference system's capabilities
+(distributed tasks/actors/objects + Data/Train/Tune/Serve/RL libraries) for
+TPU pods: JAX/XLA for all device compute, device meshes + shardings for every
+parallelism axis (DP/TP/PP/SP/EP), XLA collectives over ICI/DCN instead of
+NCCL, and Pallas kernels for the hot ops.
+"""
+
+from ray_tpu._version import version as __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.core.actor import ActorHandle, get_actor
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+)
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.placement_group import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.core.task_spec import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "get_actor",
+    "timeline",
+    "ObjectRef",
+    "ObjectRefGenerator",
+    "ActorHandle",
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "SpreadSchedulingStrategy",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+]

@@ -1,0 +1,188 @@
+"""Public API — init/shutdown, remote, get/put/wait, kill/cancel, context.
+
+The analog of the reference's top-level ``ray`` API
+(``python/ray/_private/worker.py`` — ``init`` :1214, ``get``/``put``/``wait``
+wrappers; ``python/ray/runtime_context.py``). Semantics match the reference:
+``get`` re-raises remote exceptions, ``wait`` returns (ready, not_ready),
+``kill`` terminates actors, named actors resolve through the GCS.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.core import runtime as _runtime_mod
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.core.exceptions import RuntimeNotInitializedError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.runtime import get_runtime, init_runtime, shutdown_runtime
+
+
+def init(
+    *,
+    resources: Dict[str, float] | None = None,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    num_nodes: int = 1,
+    namespace: str = "default",
+    system_config: Dict | None = None,
+    labels: Dict[str, str] | None = None,
+    ignore_reinit_error: bool = True,
+):
+    """Start the runtime (head node + N virtual nodes in-process)."""
+    if _runtime_mod._global_runtime is not None:
+        if ignore_reinit_error:
+            return _runtime_mod._global_runtime
+        raise RuntimeError("ray_tpu.init() already called")
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    return init_runtime(
+        resources=res or None,
+        num_nodes=num_nodes,
+        namespace=namespace,
+        system_config=system_config,
+        labels=labels,
+    )
+
+
+def shutdown():
+    shutdown_runtime()
+
+
+def is_initialized() -> bool:
+    return _runtime_mod._global_runtime is not None
+
+
+def _ensure_init():
+    if _runtime_mod._global_runtime is None:
+        init()
+    return _runtime_mod._global_runtime
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes.
+
+    Mirrors ``ray.remote``: bare (``@remote``) or parameterized
+    (``@remote(num_tpus=1, max_retries=5)``).
+    """
+
+    def decorate(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        if callable(target):
+            return functools.wraps(target)(RemoteFunction(target, options))  # type: ignore[return-value]
+        raise TypeError("@remote must decorate a function or class")
+
+    if len(args) == 1 and not options and (callable(args[0]) or isinstance(args[0], type)):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return decorate
+
+
+def get(refs, *, timeout: float | None = None):
+    _ensure_init()
+    return get_runtime().get(refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    _ensure_init()
+    return get_runtime().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+    fetch_local: bool = True,
+):
+    _ensure_init()
+    return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    get_runtime().kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    get_runtime().cancel(ref, force=force)
+
+
+def nodes() -> List[dict]:
+    rt = get_runtime()
+    return [
+        {
+            "NodeID": n.node_id.hex(),
+            "Alive": n.alive,
+            "Resources": n.resources,
+            "Labels": n.labels,
+            "Address": n.address,
+        }
+        for n in rt.gcs.nodes.values()
+    ]
+
+
+def cluster_resources() -> Dict[str, float]:
+    return get_runtime().gcs.cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return get_runtime().scheduler.available_resources()
+
+
+class RuntimeContext:
+    """Reference: python/ray/runtime_context.py."""
+
+    @property
+    def job_id(self):
+        return get_runtime().job_id
+
+    @property
+    def node_id(self):
+        return get_runtime().current_node_id
+
+    @property
+    def task_id(self):
+        return get_runtime().current_task_id
+
+    @property
+    def actor_id(self):
+        return get_runtime().current_actor_id
+
+    @property
+    def namespace(self):
+        return get_runtime().namespace
+
+    def get_resources(self) -> Dict[str, float]:
+        return cluster_resources()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace-style task events (reference:
+    ``python/ray/_private/state.py:434 chrome_tracing_dump``)."""
+    events = get_runtime().gcs.task_events()
+    trace = []
+    for e in events:
+        if e.get("state") == "FINISHED":
+            trace.append(
+                {
+                    "name": e["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": (e["time"] - e.get("duration", 0)) * 1e6,
+                    "dur": e.get("duration", 0) * 1e6,
+                    "pid": e.get("node_id", "node"),
+                    "tid": e["task_id"][:8],
+                }
+            )
+    return trace

@@ -1,0 +1,129 @@
+"""Global, env-overridable configuration table.
+
+Analog of the reference's ``RAY_CONFIG`` flag system
+(``src/ray/common/ray_config_def.h`` — 218 entries, each overridable by a
+``RAY_<name>`` env var or a ``_system_config`` dict passed at init). We use a
+typed dataclass-like registry: every flag is a class attribute; the value is
+resolved from (1) a ``system_config`` dict given to ``init()``, (2) the
+``RAY_TPU_<NAME>`` env var, (3) the default — in that order.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+
+class _Flag:
+    __slots__ = ("name", "default", "type")
+
+    def __init__(self, default):
+        self.default = default
+        self.type = type(default)
+        self.name = None  # filled by registry
+
+    def resolve(self, overrides: dict):
+        if self.name in overrides:
+            return self._coerce(overrides[self.name])
+        env = os.environ.get(f"RAY_TPU_{self.name.upper()}")
+        if env is not None:
+            return self._coerce(env)
+        return self.default
+
+    def _coerce(self, value):
+        if self.type is bool:
+            if isinstance(value, str):
+                return value.lower() in ("1", "true", "yes", "on")
+            return bool(value)
+        return self.type(value)
+
+
+class Config:
+    """Runtime configuration. Access via ``config()`` after init.
+
+    Flags mirror the semantically-important knobs of
+    ``src/ray/common/ray_config_def.h`` (inline-object threshold :206, health
+    check cadence :841-847, lease timeouts) plus TPU-specific additions.
+    """
+
+    # -- object store ---------------------------------------------------------
+    # Objects at or below this size are carried inline in RPC replies instead of
+    # the shared-memory store (reference: max_direct_call_object_size = 100 KiB,
+    # ray_config_def.h:206).
+    max_inline_object_size = _Flag(100 * 1024)
+    # Per-node shared-memory store capacity in bytes (plasma default sizing).
+    object_store_memory = _Flag(2 * 1024 * 1024 * 1024)
+    # Spill directory for objects evicted from the shm store.
+    object_spilling_dir = _Flag("/tmp/ray_tpu_spill")
+
+    # -- scheduling -----------------------------------------------------------
+    # Hybrid policy threshold: below this utilization prefer packing on the
+    # first (local) node, above it spread (reference
+    # hybrid_scheduling_policy.h:28-48 "scheduler_spread_threshold").
+    scheduler_spread_threshold = _Flag(0.5)
+    # Top-k fraction of candidate nodes to random-pick among.
+    scheduler_top_k_fraction = _Flag(0.2)
+    # Seconds a leased worker stays bound to a scheduling key while idle before
+    # being returned (reference: worker lease reuse in direct_task_transport).
+    idle_lease_ttl_s = _Flag(1.0)
+    # Max worker processes per node pool (reference: maximum_startup_concurrency
+    # and pool sizing in worker_pool.cc).
+    max_workers_per_node = _Flag(8)
+
+    # -- health / fault tolerance --------------------------------------------
+    # Health-check period and failure threshold (reference
+    # ray_config_def.h:841-847 health_check_{initial_delay,period,timeout}_ms,
+    # health_check_failure_threshold).
+    health_check_period_s = _Flag(1.0)
+    health_check_failure_threshold = _Flag(5)
+    # Default task retries (reference: task max_retries default 3).
+    default_max_retries = _Flag(3)
+
+    # -- timeouts -------------------------------------------------------------
+    rpc_connect_timeout_s = _Flag(10.0)
+    get_timeout_warn_s = _Flag(30.0)
+
+    # -- TPU ------------------------------------------------------------------
+    # Logical chips per host for resource autodetection when no TPU present
+    # (reference python/ray/_private/accelerators/tpu.py:13-46 — 4 chips/host).
+    tpu_chips_per_host = _Flag(4)
+
+    def __init__(self, system_config: dict | None = None):
+        overrides = dict(system_config or {})
+        for name in dir(type(self)):
+            flag = getattr(type(self), name)
+            if isinstance(flag, _Flag):
+                flag.name = name
+                object.__setattr__(self, name, flag.resolve(overrides))
+        unknown = set(overrides) - {
+            n for n in dir(type(self)) if isinstance(getattr(type(self), n), _Flag)
+        }
+        if unknown:
+            raise ValueError(f"Unknown system_config keys: {sorted(unknown)}")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            n: getattr(self, n)
+            for n in dir(type(self))
+            if isinstance(getattr(type(self), n), _Flag)
+        }
+
+
+_global: Config | None = None
+_lock = threading.Lock()
+
+
+def config() -> Config:
+    global _global
+    if _global is None:
+        with _lock:
+            if _global is None:
+                _global = Config()
+    return _global
+
+
+def set_config(cfg: Config) -> None:
+    global _global
+    with _lock:
+        _global = cfg

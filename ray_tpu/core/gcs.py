@@ -1,0 +1,237 @@
+"""GCS — Global Control Store: cluster-wide metadata and pubsub.
+
+Analog of the reference's GCS server (``src/ray/gcs/gcs_server/`` — actor
+table ``gcs_actor_manager.cc``, node table ``gcs_node_manager.cc``, job table
+``gcs_job_manager.cc``, internal KV ``gcs_kv_manager.cc``, function store
+``gcs_function_manager.h``, pubsub ``pubsub_handler.cc``). This is the
+in-process implementation used by the single-process runtime; the table API is
+transport-agnostic so the multiprocess runtime serves the same tables over
+socket RPC (see ray_tpu.core.rpc / ray_tpu.core.gcs_server).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, NodeID
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("gcs")
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str = ""
+    namespace: str = "default"
+    class_name: str = ""
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    node_id: Optional[NodeID] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    detached: bool = False
+    death_cause: str = ""
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    driver_pid: int = 0
+    start_time: float = field(default_factory=time.time)
+    end_time: float = 0.0
+    status: str = "RUNNING"
+    entrypoint: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+class PubSub:
+    """Channelized publish/subscribe (reference: ``src/ray/pubsub/`` long-poll
+    publisher; channels enumerated in ``pubsub.proto``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs.setdefault(channel, []).append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(callback)
+                except (KeyError, ValueError):
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                logger.exception("pubsub callback failed on channel %s", channel)
+
+
+class GlobalControlStore:
+    """All cluster metadata tables behind one lock-protected facade."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._functions: Dict[str, Any] = {}
+        self.pubsub = PubSub()
+        self._task_events: List[dict] = []
+
+    # -- nodes (gcs_node_manager.cc) -----------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id] = info
+        self.pubsub.publish("node", ("ALIVE", info))
+
+    def mark_node_dead(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or not info.alive:
+                return
+            info.alive = False
+        self.pubsub.publish("node", ("DEAD", info))
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total = ResourceSet()
+        for n in self.alive_nodes():
+            total = total + ResourceSet(n.resources)
+        return total.to_dict()
+
+    # -- actors (gcs_actor_manager.cc:255,280,515) ---------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            if info.name:
+                key = (info.namespace, info.name)
+                existing = self._named_actors.get(key)
+                if existing is not None:
+                    existing_info = self.actors.get(existing)
+                    if existing_info is not None and existing_info.state != "DEAD":
+                        raise ValueError(
+                            f"actor name '{info.name}' already taken in "
+                            f"namespace '{info.namespace}'"
+                        )
+                self._named_actors[key] = info.actor_id
+            self.actors[info.actor_id] = info
+
+    def update_actor_state(self, actor_id: ActorID, state: str, **fields) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            for k, v in fields.items():
+                setattr(info, k, v)
+        self.pubsub.publish("actor", (state, actor_id))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorID]:
+        with self._lock:
+            aid = self._named_actors.get((namespace, name))
+            if aid is None:
+                return None
+            info = self.actors.get(aid)
+            if info is None or info.state == "DEAD":
+                return None
+            return aid
+
+    def list_named_actors(self, namespace: str | None = None) -> List[Tuple[str, str]]:
+        with self._lock:
+            out = []
+            for (ns, name), aid in self._named_actors.items():
+                info = self.actors.get(aid)
+                if info is not None and info.state != "DEAD":
+                    if namespace is None or ns == namespace:
+                        out.append((ns, name))
+            return out
+
+    # -- jobs (gcs_job_manager.cc) -------------------------------------------
+
+    def add_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self.jobs[info.job_id] = info
+
+    def finish_job(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
+        with self._lock:
+            info = self.jobs.get(job_id)
+            if info:
+                info.status = status
+                info.end_time = time.time()
+
+    # -- internal KV (gcs_kv_manager.cc, store_client_kv.cc) -----------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "default", overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._kv.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(namespace, {}).get(key)
+
+    def kv_del(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.get(namespace, {}).pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv.get(namespace, {}) if k.startswith(prefix)]
+
+    # -- function/code store (gcs_function_manager.h) ------------------------
+
+    def export_function(self, function_id: str, payload: Any) -> None:
+        with self._lock:
+            self._functions[function_id] = payload
+
+    def get_function(self, function_id: str) -> Any:
+        with self._lock:
+            return self._functions.get(function_id)
+
+    # -- task events (gcs_task_manager.cc — observability) -------------------
+
+    def record_task_event(self, event: dict) -> None:
+        with self._lock:
+            self._task_events.append(event)
+            if len(self._task_events) > 100_000:
+                del self._task_events[: len(self._task_events) // 2]
+
+    def task_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._task_events)

@@ -1,0 +1,232 @@
+"""In-memory object store with blocking get/wait and error objects.
+
+Analog of the reference's in-process memory store
+(``src/ray/core_worker/store_provider/memory_store/``) fronting plasma
+(``src/ray/object_manager/plasma/store.cc``). One store per node; objects are
+``SerializedObject`` payloads (immutable); gets block on a condition variable;
+error results are stored as ``TaskError`` sentinels and re-raised at ``get`` —
+the same error-object scheme the reference uses (errors are plasma objects
+too). Spilling to disk when over capacity mirrors
+``local_object_manager.cc:110 SpillObjects``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject, deserialize, serialize
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("object_store")
+
+
+class StoredObject:
+    __slots__ = ("serialized", "size", "create_time", "spilled_path", "pinned")
+
+    def __init__(self, serialized: Optional[SerializedObject]):
+        self.serialized = serialized
+        self.size = serialized.total_size() if serialized is not None else 0
+        self.create_time = time.monotonic()
+        self.spilled_path = None
+        self.pinned = 0
+
+
+class MemoryStore:
+    """Node-local object store: put/get/wait/delete + readiness callbacks."""
+
+    def __init__(self, capacity_bytes: int | None = None):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._objects: Dict[ObjectID, StoredObject] = {}
+        self._ready_callbacks: Dict[ObjectID, List[Callable[[ObjectID], None]]] = {}
+        self._capacity = capacity_bytes or config().object_store_memory
+        self._used = 0
+        self._spill_dir = config().object_spilling_dir
+        self._deser_cache: Dict[ObjectID, object] = {}
+
+    # -- write path -----------------------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, serialized: SerializedObject) -> None:
+        # Copy out-of-band buffers: stored objects must not alias caller
+        # memory (a numpy array mutated after put() would silently mutate the
+        # stored object — the reference copies into plasma for the same
+        # reason).
+        if serialized.buffers:
+            serialized = SerializedObject(
+                header=serialized.header,
+                buffers=[bytes(memoryview(b).cast("B")) for b in serialized.buffers],
+            )
+        with self._lock:
+            if object_id in self._objects:
+                return  # idempotent: objects are immutable
+            entry = StoredObject(serialized)
+            if self._used + entry.size > self._capacity:
+                self._evict_locked(self._used + entry.size - self._capacity)
+            self._objects[object_id] = entry
+            self._used += entry.size
+            callbacks = self._ready_callbacks.pop(object_id, [])
+            self._cv.notify_all()
+        for cb in callbacks:
+            try:
+                cb(object_id)
+            except Exception:
+                logger.exception("object-ready callback failed")
+
+    def put(self, object_id: ObjectID, value) -> None:
+        self.put_serialized(object_id, serialize(value))
+
+    # -- read path ------------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_serialized(
+        self, object_id: ObjectID, timeout: float | None = None
+    ) -> SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while object_id not in self._objects:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"timed out waiting for {object_id}")
+                self._cv.wait(remaining)
+            entry = self._objects[object_id]
+            if entry.serialized is None:
+                entry = self._restore_locked(object_id, entry)
+            return entry.serialized
+
+    def get(self, object_id: ObjectID, timeout: float | None = None):
+        with self._lock:
+            if object_id in self._deser_cache:
+                return self._deser_cache[object_id]
+        serialized = self.get_serialized(object_id, timeout)
+        value = deserialize(serialized)
+        with self._lock:
+            # Cache only modest values to bound memory; big arrays reconstruct
+            # cheaply from their zero-copy buffers anyway.
+            if serialized.total_size() <= 1 << 20:
+                self._deser_cache[object_id] = value
+        return value
+
+    def wait(
+        self,
+        object_ids: Iterable[ObjectID],
+        num_returns: int,
+        timeout: float | None,
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        ids = list(object_ids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [oid for oid in ids if oid in self._objects]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns]
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            ready_set = set(ready)
+            not_ready = [oid for oid in ids if oid not in ready_set]
+            return ready, not_ready
+
+    def on_ready(self, object_id: ObjectID, callback: Callable[[ObjectID], None]):
+        """Invoke callback when the object becomes available (or now)."""
+        with self._lock:
+            if object_id in self._objects:
+                fire = True
+            else:
+                self._ready_callbacks.setdefault(object_id, []).append(callback)
+                fire = False
+        if fire:
+            callback(object_id)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                entry = self._objects.pop(oid, None)
+                self._deser_cache.pop(oid, None)
+                if entry is not None:
+                    if entry.serialized is not None:
+                        # _used tracks in-memory bytes only; spilled entries
+                        # were already subtracted at spill time.
+                        self._used -= entry.size
+                    if entry.spilled_path:
+                        try:
+                            os.unlink(entry.spilled_path)
+                        except OSError:
+                            pass
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._objects:
+                self._objects[object_id].pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._objects:
+                self._objects[object_id].pinned -= 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+            }
+
+    # -- spilling (holds lock) ------------------------------------------------
+
+    def _evict_locked(self, bytes_needed: int) -> None:
+        """Spill least-recently-created unpinned objects to disk.
+
+        Reference: LRU eviction (``eviction_policy.cc``) + spill orchestration
+        (``local_object_manager.cc:110``). We spill rather than drop because
+        without lineage reconstruction a dropped object is lost.
+        """
+        os.makedirs(self._spill_dir, exist_ok=True)
+        candidates = sorted(
+            (
+                (entry.create_time, oid)
+                for oid, entry in self._objects.items()
+                if entry.pinned == 0 and entry.serialized is not None
+            ),
+        )
+        freed = 0
+        for _, oid in candidates:
+            if freed >= bytes_needed:
+                break
+            entry = self._objects[oid]
+            path = os.path.join(self._spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(entry.serialized.to_bytes())
+            entry.spilled_path = path
+            entry.serialized = None
+            self._deser_cache.pop(oid, None)
+            freed += entry.size
+            self._used -= entry.size
+        if freed < bytes_needed:
+            logger.warning(
+                "object store over capacity and could not spill enough "
+                "(needed %d, freed %d)",
+                bytes_needed,
+                freed,
+            )
+
+    def _restore_locked(self, object_id: ObjectID, entry: StoredObject) -> StoredObject:
+        if not entry.spilled_path or not os.path.exists(entry.spilled_path):
+            raise ObjectLostError(object_id)
+        with open(entry.spilled_path, "rb") as f:
+            blob = f.read()
+        entry.serialized = SerializedObject.from_bytes(blob)
+        self._used += entry.size
+        return entry

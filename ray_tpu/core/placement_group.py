@@ -1,0 +1,240 @@
+"""Placement groups — gang resource reservation with topology strategies.
+
+Analog of the reference's placement groups
+(``python/ray/util/placement_group.py:41,145``; 2PC scheduling in
+``src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:113-115`` and bundle
+policies in ``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc`` —
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD). In-process the two-phase
+prepare/commit collapses to an atomic multi-node allocation with rollback on
+partial failure — the same all-or-nothing contract.
+
+TPU note: a STRICT_PACK group over ``{"TPU": k}`` bundles is the unit that
+maps to an ICI-connected slice — the scheduler's analog of the reference's
+``TPU-{pod_type}-head`` whole-slice claim (accelerators/tpu.py:363-382).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.ids import NodeID, PlacementGroupID
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.runtime import Runtime, get_runtime
+from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: Dict[str, float]
+    node_id: Optional[NodeID] = None
+
+
+@dataclass
+class PlacementGroupState:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str
+    name: str = ""
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    ready_event: threading.Event = field(default_factory=threading.Event)
+
+
+class PlacementGroupManager:
+    """Reserves bundle resources on nodes; resolves PG-scheduled tasks."""
+
+    def __init__(self, runtime: Runtime):
+        self.runtime = runtime
+        self._lock = threading.Lock()
+        self.groups: Dict[PlacementGroupID, PlacementGroupState] = {}
+
+    def create(self, bundles: List[Dict[str, float]], strategy: str, name: str = "") -> PlacementGroupState:
+        pg_id = PlacementGroupID.from_random()
+        state = PlacementGroupState(
+            pg_id=pg_id,
+            bundles=[Bundle(i, dict(b)) for i, b in enumerate(bundles)],
+            strategy=strategy,
+            name=name,
+        )
+        with self._lock:
+            self.groups[pg_id] = state
+        self._try_place(state)
+        return state
+
+    def _try_place(self, state: PlacementGroupState) -> None:
+        """Atomic prepare+commit across nodes with rollback (the in-process
+        collapse of the reference's 2PC — gcs_placement_group_scheduler.h)."""
+        sched = self.runtime.scheduler
+        placed: List[tuple] = []  # (node_id, ResourceSet)
+
+        def rollback():
+            for node_id, rs in placed:
+                sched.release(node_id, rs)
+            for b in state.bundles:
+                b.node_id = None
+
+        nodes = sched.nodes()
+        node_ids = sorted(nodes.keys())
+        strategy = state.strategy
+
+        if strategy in ("STRICT_PACK", "PACK"):
+            # Try to land every bundle on a single node first.
+            total = ResourceSet({})
+            for b in state.bundles:
+                total = total + ResourceSet(b.resources)
+            for node_id in node_ids:
+                if nodes[node_id].can_fit(total) and sched.try_allocate(node_id, total):
+                    placed.append((node_id, total))
+                    for b in state.bundles:
+                        b.node_id = node_id
+                    state.state = "CREATED"
+                    state.ready_event.set()
+                    return
+            if strategy == "STRICT_PACK":
+                return  # stays PENDING until feasible
+            # PACK falls back to any placement (prefer fewest nodes: greedy).
+
+        if strategy in ("STRICT_SPREAD", "SPREAD", "PACK"):
+            used_nodes: set = set()
+            ok = True
+            for b in state.bundles:
+                rs = ResourceSet(b.resources)
+                choice = None
+                for node_id in node_ids:
+                    if strategy == "STRICT_SPREAD" and node_id in used_nodes:
+                        continue
+                    if sched.try_allocate(node_id, rs):
+                        choice = node_id
+                        break
+                if choice is None:
+                    ok = False
+                    break
+                placed.append((choice, rs))
+                b.node_id = choice
+                used_nodes.add(choice)
+            if ok:
+                state.state = "CREATED"
+                state.ready_event.set()
+            else:
+                rollback()
+            return
+
+        raise PlacementGroupError(f"unknown strategy {strategy}")
+
+    def retry_pending(self) -> None:
+        with self._lock:
+            pending = [g for g in self.groups.values() if g.state == "PENDING"]
+        for g in pending:
+            self._try_place(g)
+
+    def remove(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            state = self.groups.get(pg_id)
+            if state is None or state.state == "REMOVED":
+                return
+        if state.state == "CREATED":
+            freed: Dict[NodeID, ResourceSet] = {}
+            for b in state.bundles:
+                if b.node_id is not None:
+                    rs = freed.get(b.node_id, ResourceSet({}))
+                    freed[b.node_id] = rs + ResourceSet(b.resources)
+            for node_id, rs in freed.items():
+                self.runtime.scheduler.release(node_id, rs)
+        state.state = "REMOVED"
+        self.runtime._on_resources_freed()
+
+    def resolve_node(self, strategy: PlacementGroupSchedulingStrategy) -> Optional[NodeID]:
+        pg: PlacementGroup = strategy.placement_group
+        state = self.groups.get(pg.id)
+        if state is None or state.state != "CREATED":
+            return None
+        idx = strategy.placement_group_bundle_index
+        if idx < 0:
+            idx = 0
+        return state.bundles[idx].node_id
+
+
+class PlacementGroup:
+    """User-facing handle (reference: util/placement_group.py:41)."""
+
+    def __init__(self, pg_id: PlacementGroupID):
+        self._id = pg_id
+
+    @property
+    def id(self) -> PlacementGroupID:
+        return self._id
+
+    def _state(self) -> PlacementGroupState:
+        mgr = _manager()
+        state = mgr.groups.get(self._id)
+        if state is None:
+            raise PlacementGroupError(f"placement group {self._id} not found")
+        return state
+
+    def ready(self, timeout: float | None = None) -> bool:
+        return self._state().ready_event.wait(timeout)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.ready(timeout)
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return [dict(b.resources) for b in self._state().bundles]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._state().bundles)
+
+    def bundle_node_ids(self) -> List[Optional[NodeID]]:
+        return [b.node_id for b in self._state().bundles]
+
+    def __reduce__(self):
+        return (PlacementGroup, (self._id,))
+
+
+def _manager() -> PlacementGroupManager:
+    rt = get_runtime()
+    if rt._pg_manager is None:
+        rt._pg_manager = PlacementGroupManager(rt)
+    return rt._pg_manager
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    """Create a placement group (reference: util/placement_group.py:145)."""
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"invalid strategy {strategy}")
+    if not bundles:
+        raise ValueError("bundles must be non-empty")
+    state = _manager().create(bundles, strategy, name)
+    return PlacementGroup(state.pg_id)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    _manager().remove(pg.id)
+
+
+def placement_group_table() -> Dict[str, dict]:
+    mgr = _manager()
+    return {
+        pg_id.hex(): {
+            "state": st.state,
+            "strategy": st.strategy,
+            "name": st.name,
+            "bundles": [
+                {"resources": b.resources, "node_id": b.node_id.hex() if b.node_id else None}
+                for b in st.bundles
+            ],
+        }
+        for pg_id, st in mgr.groups.items()
+    }

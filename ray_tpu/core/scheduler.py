@@ -1,0 +1,183 @@
+"""Cluster scheduling policies — node selection for tasks/actors/bundles.
+
+Analog of the reference's scheduler stack
+(``src/ray/raylet/scheduling/cluster_resource_scheduler.cc:141
+GetBestSchedulableNode`` with pluggable policies under
+``scheduling/policy/``): hybrid (default), spread, node-affinity, node-label,
+and the bundle policies used for placement groups
+(``bundle_scheduling_policy.cc`` — PACK/SPREAD/STRICT_PACK/STRICT_SPREAD).
+
+The hybrid policy follows the reference's documented design
+(``hybrid_scheduling_policy.h:28-48``): score each node by critical-resource
+utilization, truncated to 0 below ``scheduler_spread_threshold`` so lightly
+loaded nodes tie; prefer available (can run now) over merely feasible; pick
+randomly among the top-k tied best to avoid herd behavior.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.task_spec import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+
+class ClusterResourceScheduler:
+    """Tracks every node's load and answers 'which node should run this?'."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[NodeID, NodeResources] = {}
+        self._spread_rr = 0  # round-robin cursor for the spread policy
+
+    # -- membership -----------------------------------------------------------
+
+    def add_node(self, node_id: NodeID, resources: NodeResources) -> None:
+        with self._lock:
+            self._nodes[node_id] = resources
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def node_resources(self, node_id: NodeID) -> Optional[NodeResources]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def nodes(self) -> Dict[NodeID, NodeResources]:
+        with self._lock:
+            return dict(self._nodes)
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total = ResourceSet()
+            for nr in self._nodes.values():
+                total = total + nr.available
+            return total.to_dict()
+
+    # -- allocation ------------------------------------------------------------
+
+    def try_allocate(self, node_id: NodeID, request: ResourceSet) -> bool:
+        with self._lock:
+            nr = self._nodes.get(node_id)
+            if nr is None or not nr.can_fit(request):
+                return False
+            nr.allocate(request)
+            return True
+
+    def release(self, node_id: NodeID, request: ResourceSet) -> None:
+        with self._lock:
+            nr = self._nodes.get(node_id)
+            if nr is not None:
+                nr.release(request)
+
+    # -- node selection --------------------------------------------------------
+
+    def best_node(
+        self,
+        request: ResourceSet,
+        strategy: SchedulingStrategy | None = None,
+        preferred_node: NodeID | None = None,
+    ) -> Optional[NodeID]:
+        """GetBestSchedulableNode analog. Returns None if infeasible cluster-wide."""
+        strategy = strategy or DefaultSchedulingStrategy()
+        with self._lock:
+            if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                nr = self._nodes.get(strategy.node_id)
+                if nr is not None and nr.is_feasible(request):
+                    # Feasible-but-busy queues on the pinned node rather than
+                    # failing (matches hybrid fallback behavior).
+                    return strategy.node_id
+                if not strategy.soft:
+                    return None
+                return self._hybrid_locked(request, preferred_node)
+            if isinstance(strategy, NodeLabelSchedulingStrategy):
+                return self._label_locked(request, strategy)
+            if isinstance(strategy, SpreadSchedulingStrategy):
+                return self._spread_locked(request)
+            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                # PG bundles carry their own node binding; resolved by the
+                # PlacementGroupManager before reaching here.
+                return self._hybrid_locked(request, preferred_node)
+            return self._hybrid_locked(request, preferred_node)
+
+    def _hybrid_locked(
+        self, request: ResourceSet, preferred_node: NodeID | None
+    ) -> Optional[NodeID]:
+        cfg = config()
+        available: List[tuple] = []  # (score, is_not_preferred, node_id)
+        feasible: List[NodeID] = []
+        for node_id, nr in self._nodes.items():
+            if not nr.is_feasible(request):
+                continue
+            feasible.append(node_id)
+            if nr.can_fit(request):
+                util = nr.critical_utilization()
+                score = 0.0 if util < cfg.scheduler_spread_threshold else util
+                available.append((score, node_id != preferred_node, node_id))
+        if available:
+            available.sort(key=lambda t: (t[0], t[1]))
+            best_score = available[0][0]
+            tied = [t for t in available if t[0] == best_score]
+            top_k = max(1, int(len(tied) * cfg.scheduler_top_k_fraction))
+            return random.choice(tied[:top_k])[2]
+        if feasible:
+            # Feasible but not currently available: queue on the least loaded.
+            return min(feasible, key=lambda n: self._nodes[n].critical_utilization())
+        return None
+
+    def _spread_locked(self, request: ResourceSet) -> Optional[NodeID]:
+        ids = sorted(self._nodes.keys())
+        if not ids:
+            return None
+        n = len(ids)
+        for i in range(n):
+            node_id = ids[(self._spread_rr + i) % n]
+            if self._nodes[node_id].can_fit(request):
+                self._spread_rr = (self._spread_rr + i + 1) % n
+                return node_id
+        for i in range(n):
+            node_id = ids[(self._spread_rr + i) % n]
+            if self._nodes[node_id].is_feasible(request):
+                return node_id
+        return None
+
+    def _label_locked(
+        self, request: ResourceSet, strategy: NodeLabelSchedulingStrategy
+    ) -> Optional[NodeID]:
+        def matches(nr: NodeResources, constraints: Dict[str, object]) -> bool:
+            for key, want in constraints.items():
+                have = nr.labels.get(key)
+                if isinstance(want, (list, tuple, set)):
+                    if have not in want:
+                        return False
+                elif have != want:
+                    return False
+            return True
+
+        hard_ok = [
+            nid
+            for nid, nr in self._nodes.items()
+            if nr.is_feasible(request) and matches(nr, strategy.hard)
+        ]
+        if not hard_ok:
+            return None
+        soft_ok = [
+            nid
+            for nid in hard_ok
+            if matches(self._nodes[nid], strategy.soft)
+            and self._nodes[nid].can_fit(request)
+        ]
+        pool = soft_ok or [n for n in hard_ok if self._nodes[n].can_fit(request)] or hard_ok
+        return min(pool, key=lambda n: self._nodes[n].critical_utilization())

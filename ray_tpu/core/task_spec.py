@@ -1,0 +1,134 @@
+"""Task IR — the universal description of a unit of remote work.
+
+Analog of the reference's ``TaskSpec`` protobuf
+(``src/ray/protobuf/common.proto:398`` — function descriptor, args as inline
+values or object references, resource shape, retry policy, scheduling
+strategy, actor-creation payload). We keep it a plain picklable dataclass so
+the same IR flows through the in-process scheduler today and socket RPC in the
+multiprocess runtime.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.resources import ResourceSet
+
+
+class TaskType(enum.Enum):
+    # Mirrors common.proto:41 TaskType.
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class TaskArg:
+    """Either an inline (already serialized-with-the-spec) value or a ref."""
+
+    value: Any = None
+    object_id: Optional[ObjectID] = None
+
+    @property
+    def is_ref(self) -> bool:
+        return self.object_id is not None
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base for scheduling strategies (common.proto:111 SchedulingStrategy)."""
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    # reference: python/ray/util/scheduling_strategies.py
+    node_id: Any = None
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    hard: Dict[str, Any] = field(default_factory=dict)
+    soft: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TaskOptions:
+    """Resolved per-call options (reference:
+    ``python/ray/_private/ray_option_utils.py``)."""
+
+    name: str = ""
+    num_returns: Any = 1  # int | "dynamic" | "streaming"
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: Any = False  # bool | list[type]
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=DefaultSchedulingStrategy
+    )
+    # Actor-only options
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    lifetime: Optional[str] = None  # None | "detached"
+    namespace: Optional[str] = None
+    get_if_exists: bool = False
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+
+    def resource_set(self) -> ResourceSet:
+        return ResourceSet(self.resources)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function_id: str
+    function_name: str
+    args: List[TaskArg]
+    kwargs: Dict[str, TaskArg]
+    options: TaskOptions
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    actor_method: Optional[str] = None
+    actor_creation_class_id: Optional[str] = None
+    # Ordering: per-caller sequence number for actor tasks (reference:
+    # sequential_actor_submit_queue.cc enforces submission order). caller_id
+    # identifies the submitting handle instance.
+    sequence_number: int = 0
+    caller_id: str = ""
+    concurrency_group: str = ""
+    # Retry bookkeeping
+    attempt_number: int = 0
+
+    def return_object_ids(self, num: Optional[int] = None) -> List[ObjectID]:
+        n = num if num is not None else (
+            self.options.num_returns if isinstance(self.options.num_returns, int) else 0
+        )
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(n)]
+
+    def dependencies(self) -> List[ObjectID]:
+        deps = [a.object_id for a in self.args if a.is_ref]
+        deps += [a.object_id for a in self.kwargs.values() if a.is_ref]
+        return deps

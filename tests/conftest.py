@@ -1,0 +1,52 @@
+"""Test fixtures.
+
+Mirrors the reference's conftest strategy
+(``python/ray/tests/conftest.py:419 ray_start_regular``, ``:500
+ray_start_cluster``): a fresh runtime per test, plus a multi-virtual-node
+cluster fixture with fake resources — the single-host trick that makes all
+scheduler/fault-tolerance logic testable without real machines
+(``python/ray/cluster_utils.py:135``).
+
+JAX runs on a virtual 8-device CPU mesh so every sharding/collective test
+exercises real multi-device SPMD without a TPU pod.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process. Tests always run
+# on the virtual 8-device CPU mesh, even when a real TPU is attached.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(resources={"CPU": 4, "TPU": 8})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """4 virtual nodes, 2 CPU + 4 TPU each."""
+    import ray_tpu
+
+    ray_tpu.init(resources={"CPU": 2, "TPU": 4}, num_nodes=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host-platform devices"
+    return devices
