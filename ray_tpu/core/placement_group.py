@@ -6,7 +6,10 @@ Analog of the reference's placement groups
 policies in ``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc`` —
 PACK/SPREAD/STRICT_PACK/STRICT_SPREAD). In-process the two-phase
 prepare/commit collapses to an atomic multi-node allocation with rollback on
-partial failure — the same all-or-nothing contract.
+partial failure — the same all-or-nothing contract. Tasks/actors scheduled
+into a bundle draw from the bundle's reservation (per-bundle admission
+control, the analog of the reference's ``CPU_group_<pgid>`` shadow
+resources).
 
 TPU note: a STRICT_PACK group over ``{"TPU": k}`` bundles is the unit that
 maps to an ICI-connected slice — the scheduler's analog of the reference's
@@ -17,7 +20,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ray_tpu.core.exceptions import RayTpuError
 from ray_tpu.core.ids import NodeID, PlacementGroupID
@@ -35,6 +38,8 @@ class Bundle:
     index: int
     resources: Dict[str, float]
     node_id: Optional[NodeID] = None
+    # Admission accounting: how much of the reservation is currently unused.
+    available: ResourceSet = field(default_factory=lambda: ResourceSet({}))
 
 
 @dataclass
@@ -45,14 +50,20 @@ class PlacementGroupState:
     name: str = ""
     state: str = "PENDING"  # PENDING | CREATED | REMOVED
     ready_event: threading.Event = field(default_factory=threading.Event)
+    waiters: List[Callable[[], None]] = field(default_factory=list)
 
 
 class PlacementGroupManager:
-    """Reserves bundle resources on nodes; resolves PG-scheduled tasks."""
+    """Reserves bundle resources on nodes; resolves PG-scheduled work.
+
+    One lock guards the group table and all placement decisions — placement
+    retries run on worker threads after every resource release, so racing
+    placements of the same PENDING group must serialize.
+    """
 
     def __init__(self, runtime: Runtime):
         self.runtime = runtime
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self.groups: Dict[PlacementGroupID, PlacementGroupState] = {}
 
     def create(self, bundles: List[Dict[str, float]], strategy: str, name: str = "") -> PlacementGroupState:
@@ -65,14 +76,29 @@ class PlacementGroupManager:
         )
         with self._lock:
             self.groups[pg_id] = state
-        self._try_place(state)
+            self._try_place_locked(state)
+        self._flush_waiters(state)
         return state
 
-    def _try_place(self, state: PlacementGroupState) -> None:
+    def _flush_waiters(self, state: PlacementGroupState) -> None:
+        if state.state != "CREATED":
+            return
+        with self._lock:
+            waiters, state.waiters = state.waiters, []
+        for cb in waiters:
+            cb()
+
+    def _try_place_locked(self, state: PlacementGroupState) -> None:
         """Atomic prepare+commit across nodes with rollback (the in-process
         collapse of the reference's 2PC — gcs_placement_group_scheduler.h)."""
         sched = self.runtime.scheduler
         placed: List[tuple] = []  # (node_id, ResourceSet)
+
+        def commit():
+            for b in state.bundles:
+                b.available = ResourceSet(b.resources)
+            state.state = "CREATED"
+            state.ready_event.set()
 
         def rollback():
             for node_id, rs in placed:
@@ -94,12 +120,11 @@ class PlacementGroupManager:
                     placed.append((node_id, total))
                     for b in state.bundles:
                         b.node_id = node_id
-                    state.state = "CREATED"
-                    state.ready_event.set()
+                    commit()
                     return
             if strategy == "STRICT_PACK":
                 return  # stays PENDING until feasible
-            # PACK falls back to any placement (prefer fewest nodes: greedy).
+            # PACK falls back to any placement (greedy best-effort).
 
         if strategy in ("STRICT_SPREAD", "SPREAD", "PACK"):
             used_nodes: set = set()
@@ -120,8 +145,7 @@ class PlacementGroupManager:
                 b.node_id = choice
                 used_nodes.add(choice)
             if ok:
-                state.state = "CREATED"
-                state.ready_event.set()
+                commit()
             else:
                 rollback()
             return
@@ -129,36 +153,90 @@ class PlacementGroupManager:
         raise PlacementGroupError(f"unknown strategy {strategy}")
 
     def retry_pending(self) -> None:
+        flushed: List[PlacementGroupState] = []
         with self._lock:
-            pending = [g for g in self.groups.values() if g.state == "PENDING"]
-        for g in pending:
-            self._try_place(g)
+            for g in self.groups.values():
+                if g.state == "PENDING":
+                    self._try_place_locked(g)
+                    if g.state == "CREATED":
+                        flushed.append(g)
+        for g in flushed:
+            self._flush_waiters(g)
+
+    def when_ready(self, pg_id: PlacementGroupID, callback: Callable[[], None]) -> bool:
+        """Run callback once the group is CREATED (now, or on placement).
+
+        Returns False if the group is removed/unknown (caller should error).
+        """
+        with self._lock:
+            state = self.groups.get(pg_id)
+            if state is None or state.state == "REMOVED":
+                return False
+            if state.state == "PENDING":
+                state.waiters.append(callback)
+                return True
+        callback()
+        return True
 
     def remove(self, pg_id: PlacementGroupID) -> None:
         with self._lock:
             state = self.groups.get(pg_id)
             if state is None or state.state == "REMOVED":
                 return
-        if state.state == "CREATED":
-            freed: Dict[NodeID, ResourceSet] = {}
-            for b in state.bundles:
-                if b.node_id is not None:
-                    rs = freed.get(b.node_id, ResourceSet({}))
-                    freed[b.node_id] = rs + ResourceSet(b.resources)
-            for node_id, rs in freed.items():
-                self.runtime.scheduler.release(node_id, rs)
-        state.state = "REMOVED"
+            if state.state == "CREATED":
+                freed: Dict[NodeID, ResourceSet] = {}
+                for b in state.bundles:
+                    if b.node_id is not None:
+                        rs = freed.get(b.node_id, ResourceSet({}))
+                        freed[b.node_id] = rs + ResourceSet(b.resources)
+                for node_id, rs in freed.items():
+                    self.runtime.scheduler.release(node_id, rs)
+            state.state = "REMOVED"
         self.runtime._on_resources_freed()
 
-    def resolve_node(self, strategy: PlacementGroupSchedulingStrategy) -> Optional[NodeID]:
-        pg: PlacementGroup = strategy.placement_group
+    # -- bundle admission (shadow-resource analog) ----------------------------
+
+    def _bundle_for(self, strategy: PlacementGroupSchedulingStrategy) -> Optional[Bundle]:
+        pg = strategy.placement_group
+        if pg is None:
+            return None
         state = self.groups.get(pg.id)
         if state is None or state.state != "CREATED":
             return None
-        idx = strategy.placement_group_bundle_index
-        if idx < 0:
-            idx = 0
-        return state.bundles[idx].node_id
+        idx = max(0, strategy.placement_group_bundle_index)
+        if idx >= len(state.bundles):
+            return None
+        return state.bundles[idx]
+
+    def acquire_from_bundle(
+        self, strategy: PlacementGroupSchedulingStrategy, request: ResourceSet
+    ) -> bool:
+        with self._lock:
+            bundle = self._bundle_for(strategy)
+            if bundle is None:
+                return False
+            if not request.is_subset_of(bundle.available):
+                return False
+            bundle.available = bundle.available - request
+            return True
+
+    def release_to_bundle(
+        self, strategy: PlacementGroupSchedulingStrategy, request: ResourceSet
+    ) -> None:
+        with self._lock:
+            bundle = self._bundle_for(strategy)
+            if bundle is not None:
+                bundle.available = bundle.available + request
+
+    def resolve_node(self, strategy: PlacementGroupSchedulingStrategy) -> Optional[NodeID]:
+        with self._lock:
+            bundle = self._bundle_for(strategy)
+            return bundle.node_id if bundle is not None else None
+
+    def group_state(self, pg_id: PlacementGroupID) -> Optional[str]:
+        with self._lock:
+            state = self.groups.get(pg_id)
+            return state.state if state else None
 
 
 class PlacementGroup:

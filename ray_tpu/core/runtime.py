@@ -90,8 +90,10 @@ class TaskState:
         "node_id",
         "cancelled",
         "deps_remaining",
+        "deps_released",
         "lock",
         "resources",
+        "bundle_held",
         "generator_items",
         "generator_done",
         "generator_cv",
@@ -103,8 +105,12 @@ class TaskState:
         self.node_id: Optional[NodeID] = None
         self.cancelled = False
         self.deps_remaining = 0
-        self.lock = threading.Lock()
+        self.deps_released = True  # armed by _resolve_dependencies
+        # RLock: terminal paths (_finish_cancelled → _release_dep_refs) nest
+        # under cancel()'s hold of the same lock.
+        self.lock = threading.RLock()
         self.resources: Optional[ResourceSet] = None
+        self.bundle_held = None  # (strategy, ResourceSet) while running in a PG bundle
         self.generator_items: List[ObjectID] = []
         self.generator_done = False
         self.generator_cv = threading.Condition(self.lock)
@@ -135,6 +141,8 @@ class LocalNode:
         """Drain the pending queue subject to resource availability.
 
         Reference: ``local_task_manager.cc`` DispatchScheduledTasksToWorkers.
+        PG-scheduled work additionally passes per-bundle admission (the
+        shadow-resource accounting of the reference's ``CPU_group_<pgid>``).
         """
         while True:
             with self.lock:
@@ -144,6 +152,16 @@ class LocalNode:
                 request = self.runtime._resource_request(state.spec)
                 if not self.runtime.scheduler.try_allocate(self.node_id, request):
                     return
+                strategy = state.spec.options.scheduling_strategy
+                from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy as _PGS
+
+                if isinstance(strategy, _PGS) and self.runtime._pg_manager is not None:
+                    bundle_req = self.runtime._declared_resources(state.spec)
+                    if not self.runtime._pg_manager.acquire_from_bundle(strategy, bundle_req):
+                        # Bundle full: roll back the node grant, stay queued.
+                        self.runtime.scheduler.release(self.node_id, request)
+                        return
+                    state.bundle_held = (strategy, bundle_req)
                 self.pending.popleft()
                 state.resources = request
                 state.status = "RUNNING"
@@ -187,6 +205,7 @@ class ActorRunner:
         self._threads: List[threading.Thread] = []
         self._running = 0
         self.held_resources: ResourceSet = ResourceSet({})
+        self.bundle_held = None  # (strategy, ResourceSet) while alive in a PG
 
     def start(self, instance) -> None:
         import asyncio
@@ -251,6 +270,10 @@ class ActorRunner:
     def _async_main(self) -> None:
         import asyncio
 
+        # The loop thread belongs to exactly this actor: bind the context so
+        # runtime_context/collectives resolve the actor from coroutines.
+        self.runtime._ctx.actor_id = self.actor_id
+        self.runtime._ctx.node_id = self.node_id
         asyncio.set_event_loop(self._loop)
         self._loop.run_forever()
 
@@ -510,6 +533,8 @@ class Runtime:
         through; ref args wait for local availability.
         """
         deps = state.spec.dependencies()
+        with state.lock:
+            state.deps_released = False  # new attempt holds fresh dep refs
         for oid in deps:
             self.reference_counter.add_submitted_task_reference(oid)
         if not deps:
@@ -539,6 +564,13 @@ class Runtime:
         preferred = self._ctx.node_id or self.head_node_id
         if isinstance(strategy, PlacementGroupSchedulingStrategy) and self._pg_manager is not None:
             node_id = self._pg_manager.resolve_node(strategy)
+            if node_id is None and strategy.placement_group is not None:
+                # Group still PENDING: defer until placed (reference queues
+                # PG-scheduled work until the 2PC commits).
+                if self._pg_manager.when_ready(
+                    strategy.placement_group.id, lambda: self._schedule(state)
+                ):
+                    return
         else:
             node_id = self.scheduler.best_node(request, strategy, preferred)
         if node_id is None or node_id not in self.nodes:
@@ -552,18 +584,43 @@ class Runtime:
         state.status = "QUEUED"
         self.nodes[node_id].queue_task(state)
 
-    def _resource_request(self, spec: TaskSpec) -> ResourceSet:
+    def _declared_resources(self, spec: TaskSpec) -> ResourceSet:
         res = dict(spec.options.resources)
         if spec.task_type == TaskType.NORMAL_TASK and "CPU" not in res:
             res["CPU"] = 1.0
+        return ResourceSet(res)
+
+    def _resource_request(self, spec: TaskSpec) -> ResourceSet:
         if isinstance(spec.options.scheduling_strategy, PlacementGroupSchedulingStrategy):
-            # Bundle resources were reserved at PG creation; don't double-count.
+            # Bundle resources were reserved at PG creation; admission happens
+            # against the bundle (dispatch), not the node.
             pg = spec.options.scheduling_strategy.placement_group
             if pg is not None:
                 return ResourceSet({})
-        return ResourceSet(res)
+        return self._declared_resources(spec)
+
+    def _release_bundle(self, state: TaskState) -> None:
+        if state.bundle_held is not None and self._pg_manager is not None:
+            strategy, request = state.bundle_held
+            state.bundle_held = None
+            self._pg_manager.release_to_bundle(strategy, request)
 
     # -- task execution -------------------------------------------------------
+
+    def _release_dep_refs(self, state: TaskState) -> None:
+        """Drop this attempt's submitted-task refs exactly once.
+
+        Reference: TaskManager releases argument refs on task completion
+        (task_manager.cc); every terminal path (success, error, cancel,
+        pre-scheduling failure) funnels through here, guarded so the
+        execute-path finally and _store_error can both call it safely.
+        """
+        with state.lock:
+            if state.deps_released:
+                return
+            state.deps_released = True
+        for oid in state.spec.dependencies():
+            self.reference_counter.remove_submitted_task_reference(oid)
 
     def _fetch_args(self, spec: TaskSpec):
         def resolve(arg: TaskArg):
@@ -582,9 +639,12 @@ class Runtime:
         if isinstance(state, _ActorCreationState):
             held = state.resources or ResourceSet({})
             state.resources = None  # the actor keeps them; skip release below
+            runner = state.runner_ref
+            # Bundle admission transfers to the actor for its lifetime.
+            runner.bundle_held, state.bundle_held = state.bundle_held, None
             try:
                 self._instantiate_actor(
-                    state.actor_id_ref, state.spec, node.node_id, held, state.runner_ref
+                    state.actor_id_ref, state.spec, node.node_id, held, runner
                 )
             finally:
                 node.dispatch()
@@ -600,6 +660,7 @@ class Runtime:
         self._ctx.held_resources = held
         self._ctx.held_node = node.node_id
         started = time.time()
+        failure: Optional[BaseException] = None
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
@@ -614,11 +675,11 @@ class Runtime:
                  "time": time.time(), "duration": time.time() - started, "node_id": node.node_id.hex()}
             )
         except _DependencyFailed as df:
-            self._store_error(state, df.error, retryable=False)
+            self._store_error(state, df.error)
         except TaskCancelledError:
             self._finish_cancelled(state)
         except BaseException as e:  # noqa: BLE001 — worker boundary
-            self._retry_or_fail(state, e)
+            failure = e
         finally:
             self._ctx.in_worker = False
             self._ctx.task_state = None
@@ -627,9 +688,24 @@ class Runtime:
             self._ctx.held_node = None
             if held is not None:
                 self.scheduler.release(node.node_id, held)
-            for oid in spec.dependencies():
-                self.reference_counter.remove_submitted_task_reference(oid)
+            self._release_bundle(state)
+            # Release this attempt's dep refs BEFORE any retry resubmission
+            # re-arms them — ordering keeps the counts exact.
+            self._release_dep_refs(state)
+            if failure is not None:
+                self._retry_or_fail(state, failure)
+            if state.status in ("FINISHED", "FAILED", "CANCELLED") and not state.generator_items:
+                with self._lock:
+                    self.tasks.pop(spec.task_id, None)
             self._on_resources_freed(node)
+
+    def _put_result(self, oid: ObjectID, value) -> None:
+        """Store a task result; free it immediately if nobody can ever read
+        it (all result ObjectRefs already dropped — fire-and-forget tasks
+        must not accumulate garbage in the store)."""
+        self.store.put(oid, value)
+        if self.reference_counter.num_references(oid) == 0:
+            self.store.delete([oid])
 
     def _store_results(self, state: TaskState, result) -> None:
         spec = state.spec
@@ -661,7 +737,7 @@ class Runtime:
             state.status = "FINISHED"
             return
         if num_returns == 1:
-            self.store.put(oids[0], result)
+            self._put_result(oids[0], result)
         else:
             values = list(result)
             if len(values) != num_returns:
@@ -670,12 +746,13 @@ class Runtime:
                     f"but returned {len(values)} values"
                 )
             for oid, v in zip(oids, values):
-                self.store.put(oid, v)
+                self._put_result(oid, v)
         state.status = "FINISHED"
 
-    def _store_error(self, state: TaskState, error: TaskError | TaskCancelledError | ActorError, retryable=True) -> None:
+    def _store_error(self, state: TaskState, error: TaskError | TaskCancelledError | ActorError) -> None:
         spec = state.spec
         state.status = "FAILED"
+        self._release_dep_refs(state)
         num_returns = spec.options.num_returns
         if num_returns in ("dynamic", "streaming"):
             oid = ObjectID.for_task_return(spec.task_id, len(state.generator_items))
@@ -686,7 +763,7 @@ class Runtime:
                 state.generator_cv.notify_all()
             return
         for oid in spec.return_object_ids(max(1, num_returns if isinstance(num_returns, int) else 1)):
-            self.store.put(oid, error)
+            self._put_result(oid, error)
 
     def _retry_or_fail(self, state: TaskState, exc: BaseException) -> None:
         """Task retry ladder (task_manager.cc — max_retries, retry_exceptions)."""
@@ -713,10 +790,11 @@ class Runtime:
 
     def _finish_cancelled(self, state: TaskState) -> None:
         state.status = "CANCELLED"
+        self._release_dep_refs(state)
         err = TaskCancelledError(state.spec.task_id)
         num_returns = state.spec.options.num_returns
         for oid in state.spec.return_object_ids(max(1, num_returns if isinstance(num_returns, int) else 1)):
-            self.store.put(oid, err)
+            self._put_result(oid, err)
 
     # -- blocked-worker resource release (deadlock avoidance) -----------------
 
@@ -807,6 +885,16 @@ class Runtime:
                 # rides the reservation (same rule as PG tasks).
                 request = ResourceSet({})
                 node_id = self._pg_manager.resolve_node(strategy)
+                if node_id is None and strategy.placement_group is not None:
+                    if self._pg_manager.when_ready(strategy.placement_group.id, do_create):
+                        return
+                if node_id is not None and node_id in self.nodes:
+                    # Bundle admission + instantiation ride the node queue so
+                    # per-bundle accounting applies uniformly.
+                    self.nodes[node_id].queue_task(
+                        _ActorCreationState(self, actor_id, spec, node_id, runner)
+                    )
+                    return
             else:
                 request = ResourceSet(spec.options.resources)
                 # Actors with no explicit resources are placed by CPU
@@ -852,6 +940,10 @@ class Runtime:
         except BaseException as e:  # noqa: BLE001
             if not held.is_empty():
                 self.scheduler.release(node_id, held)
+            if runner.bundle_held is not None and self._pg_manager is not None:
+                strategy, request = runner.bundle_held
+                runner.bundle_held = None
+                self._pg_manager.release_to_bundle(strategy, request)
             err = e if isinstance(e, ActorError) else ActorDiedError(
                 actor_id, f"creation failed: {''.join(traceback.format_exception_only(type(e), e)).strip()}"
             )
@@ -942,6 +1034,13 @@ class Runtime:
             self._ctx.in_worker = False
             self._ctx.task_id = None
             self._ctx.actor_id = None
+            self._finalize_actor_task(state)
+
+    def _finalize_actor_task(self, state: TaskState) -> None:
+        self._release_dep_refs(state)
+        if not state.generator_items:
+            with self._lock:
+                self.tasks.pop(state.spec.task_id, None)
 
     async def _execute_actor_task_async(self, runner: ActorRunner, state: TaskState) -> None:
         spec = state.spec
@@ -962,6 +1061,8 @@ class Runtime:
             self._finish_cancelled(state)
         except BaseException as e:  # noqa: BLE001
             self._store_error(state, TaskError.from_exception(f"{spec.function_name}.{spec.actor_method}", e))
+        finally:
+            self._finalize_actor_task(state)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._handle_actor_failure(actor_id, ActorDiedError(actor_id, "killed via kill()"), allow_restart=not no_restart)
@@ -977,7 +1078,11 @@ class Runtime:
         if not held.is_empty() and runner.node_id in self.nodes:
             self.scheduler.release(runner.node_id, held)
             runner.held_resources = ResourceSet({})
-            self._on_resources_freed(self.nodes.get(runner.node_id))
+        if runner.bundle_held is not None and self._pg_manager is not None:
+            strategy, request = runner.bundle_held
+            runner.bundle_held = None
+            self._pg_manager.release_to_bundle(strategy, request)
+        self._on_resources_freed(self.nodes.get(runner.node_id) if runner.node_id else None)
         for state in drained:
             self._store_error(state, err)
         info = self.gcs.get_actor(actor_id)

@@ -1,0 +1,69 @@
+"""Core neural-net ops, TPU-shaped.
+
+Conventions: params are plain pytrees of jnp arrays; computation runs in the
+array's dtype with float32 accumulation where it matters (layernorm stats,
+attention softmax, loss). Matmuls use ``preferred_element_type=float32`` so
+bf16 params hit the MXU with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """LayerNorm with f32 statistics regardless of input dtype."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, gamma, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps) * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu(x):
+    # tanh approximation (GPT-2 uses this exact form)
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * (x + 0.044715 * x * x * x)))
+
+
+def linear(x, w, b=None):
+    y = jnp.einsum("...d,df->...f", x, w, preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def softmax_cross_entropy(logits, labels, ignore_index: int = -100):
+    """Token-level CE with f32 logits; ignores masked positions.
+
+    Returns (mean_loss, n_valid_tokens).
+    """
+    logits32 = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    n = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / n, n
+
+
+def rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding on the last dim (pairs interleaved as
+    [even|odd] halves). x: [..., L, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.log(base) * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., L, half]
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)

@@ -1,0 +1,266 @@
+"""Eager actor-level collectives — the §5.8 API contract.
+
+Analog of the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py`` — ``init_collective_group``
+:120, ``create_collective_group`` :151, ``allreduce`` :258, ``barrier`` :298,
+``broadcast`` :373, ``allgather`` :423, ``reducescatter`` :472, ``send``
+:531 / ``recv`` :594) re-based for the TPU world:
+
+- **Compiled path (the fast path):** device tensors inside a jitted program
+  use XLA collectives over ICI (``psum``/``all_gather``/...) — that path
+  lives in the mesh/sharding layer, not here.
+- **Eager path (this module):** host-side arrays exchanged between actors in
+  a named group — rendezvous through the runtime's control plane exactly the
+  way the reference rendezvouses NCCL unique ids through its KV store
+  (``nccl_collective_group.py``). The local backend synchronizes ranks with
+  barriers and reduces with numpy; it is the Gloo analog and the test
+  substrate for multi-host DCN collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("collectives")
+
+_REDUCE_OPS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+}
+
+
+class _GroupState:
+    """Shared rendezvous state for one collective group (local backend)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.epoch = 0
+        self.slots: Dict[int, np.ndarray] = {}
+        self.result = None
+        self.arrived = 0
+        self.departed = 0
+        # Point-to-point mailboxes: (src, dst) -> list of arrays.
+        self.p2p: Dict[tuple, List[np.ndarray]] = {}
+
+    def exchange(self, rank: int, value, compute):
+        """All ranks deposit, one computes, all withdraw. Returns result."""
+        with self.cv:
+            epoch = self.epoch
+            self.slots[rank] = value
+            self.arrived += 1
+            if self.arrived == self.world_size:
+                self.result = compute(self.slots)
+                self.cv.notify_all()
+            else:
+                while self.epoch == epoch and self.arrived < self.world_size:
+                    if not self.cv.wait(timeout=60.0):
+                        raise TimeoutError(
+                            f"collective timed out at rank {rank} "
+                            f"({self.arrived}/{self.world_size} arrived)"
+                        )
+            result = self.result
+            self.departed += 1
+            if self.departed == self.world_size:
+                # Reset for the next collective on this group.
+                self.slots = {}
+                self.arrived = 0
+                self.departed = 0
+                self.result = None
+                self.epoch += 1
+                self.cv.notify_all()
+            return result
+
+
+@dataclass
+class GroupInfo:
+    name: str
+    world_size: int
+    backend: str
+
+
+_groups: Dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+# rank registry keyed by execution context: an actor's rank is visible from
+# every thread that executes its methods (actor init and method calls run on
+# different threads in the runtime).
+_ranks: Dict[tuple, Dict[str, int]] = {}
+
+
+def _ctx_key() -> tuple:
+    try:
+        rt = get_runtime()
+        aid = rt.current_actor_id
+        if aid is not None:
+            return ("actor", aid)
+    except Exception:
+        pass
+    return ("thread", threading.get_ident())
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "local",
+    group_name: str = "default",
+) -> None:
+    """Join a named collective group (reference: collective.py:120).
+
+    Every member actor/task calls this with its rank; the group state
+    rendezvouses through the process-wide registry (the analog of NCCL
+    unique-id exchange via the reference's internal KV).
+    """
+    if backend not in ("local", "gloo", "xla"):
+        raise ValueError(f"unknown backend {backend}")
+    with _groups_lock:
+        state = _groups.get(group_name)
+        if state is None:
+            state = _GroupState(world_size)
+            _groups[group_name] = state
+        elif state.world_size != world_size:
+            raise ValueError(
+                f"group {group_name} exists with world_size={state.world_size}"
+            )
+    with _groups_lock:
+        _ranks.setdefault(_ctx_key(), {})[group_name] = rank
+    # Record membership in the control plane for observability.
+    try:
+        get_runtime().gcs.kv_put(
+            f"collective:{group_name}:{rank}", b"1", namespace="collective"
+        )
+    except Exception:
+        pass
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    with _groups_lock:
+        ranks = _ranks.get(_ctx_key(), {})
+        if group_name in ranks:
+            return ranks[group_name]
+    raise RuntimeError(
+        f"init_collective_group must be called in this actor/task first "
+        f"(group={group_name})"
+    )
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    state = _group(group_name)
+    return state.world_size
+
+
+def _group(group_name: str) -> _GroupState:
+    with _groups_lock:
+        state = _groups.get(group_name)
+    if state is None:
+        raise RuntimeError(f"collective group '{group_name}' not initialized")
+    return state
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default"):
+    """reference: collective.py:258."""
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"unknown reduce op {op}")
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    value = _to_numpy(tensor)
+    return state.exchange(
+        rank, value, lambda slots: _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
+    )
+
+
+def barrier(group_name: str = "default") -> None:
+    """reference: collective.py:298."""
+    state = _group(group_name)
+    state.exchange(get_rank(group_name), None, lambda slots: None)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """reference: collective.py:373."""
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    value = _to_numpy(tensor) if rank == src_rank else None
+    return state.exchange(rank, value, lambda slots: slots[src_rank])
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    """reference: collective.py:423. Returns list of per-rank tensors."""
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    return state.exchange(
+        rank, _to_numpy(tensor), lambda slots: [slots[r] for r in sorted(slots)]
+    )
+
+
+def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
+    """reference: collective.py:472. Input split along dim 0 across ranks;
+    each rank receives its reduced shard."""
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"unknown reduce op {op}")
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    world = state.world_size
+
+    def compute(slots):
+        reduced = _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
+        return np.array_split(reduced, world, axis=0)
+
+    shards = state.exchange(rank, _to_numpy(tensor), compute)
+    return shards[rank]
+
+
+def alltoall(tensor, group_name: str = "default"):
+    """Each rank's input is split along dim 0; shard i goes to rank i.
+
+    The host-side analog of XLA ``all_to_all`` (expert-parallel routing).
+    """
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    world = state.world_size
+
+    def compute(slots):
+        split = {r: np.array_split(slots[r], world, axis=0) for r in slots}
+        return {r: np.concatenate([split[s][r] for s in sorted(split)], axis=0)
+                for r in range(world)}
+
+    return state.exchange(rank, _to_numpy(tensor), compute)[rank]
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """reference: collective.py:531 (p2p)."""
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    with state.cv:
+        state.p2p.setdefault((rank, dst_rank), []).append(_to_numpy(tensor))
+        state.cv.notify_all()
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    """reference: collective.py:594 (p2p)."""
+    state = _group(group_name)
+    rank = get_rank(group_name)
+    key = (src_rank, rank)
+    with state.cv:
+        while not state.p2p.get(key):
+            if not state.cv.wait(timeout=timeout):
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+        return state.p2p[key].pop(0)

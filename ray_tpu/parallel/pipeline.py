@@ -1,0 +1,95 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a mesh axis.
+
+The reference delegates PP to DeepSpeed/Accelerate (SURVEY §2.4) and offers
+only the compiled-DAG primitive (``python/ray/dag/compiled_dag_node.py``) for
+cross-actor pipelining. TPU-native, the pipeline is a mesh axis: every device
+holds one stage's parameters (leading ``layers`` dim sharded on ``pipe``),
+activations hand off to the next stage via ``ppermute`` each tick, and the
+whole schedule is one compiled XLA program — no per-tick host round-trips.
+
+Schedule: classic GPipe fill-drain. For M microbatches on S stages the loop
+runs M + S - 1 ticks; at tick t stage 0 ingests microbatch t (if any) and
+stage S-1 emits microbatch t - (S - 1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_pipeline(
+    stage_fn: Callable,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    pipe_axis: str = "pipe",
+    batch_axes=("data", "fsdp"),
+):
+    """Build a jittable pipelined forward pass.
+
+    ``stage_fn(stage_params, x) -> y`` is the per-stage computation; activations
+    must have the same shape as inputs (transformer blocks qualify).
+
+    Arguments to the returned function:
+    - ``stage_params``: pytree whose leaves have leading dim = n_stages,
+      sharded on ``pipe_axis``.
+    - ``x``: [num_microbatches, microbatch, ...] input, replicated over pipe.
+
+    Returns [num_microbatches, microbatch, ...] outputs (replicated over pipe).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    ticks = num_microbatches + n_stages - 1
+
+    def body(stage_params, x):
+        # Local leaves have leading dim 1 (our stage); drop it.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+        stage = lax.axis_index(pipe_axis)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        mb_shape = x.shape[1:]
+
+        out0 = jnp.zeros_like(x)
+        carry0 = jnp.zeros(mb_shape, x.dtype)  # activation arriving this tick
+
+        def tick(t, state):
+            carry, out = state
+            mb_index = jnp.clip(t, 0, num_microbatches - 1)
+            fresh = lax.dynamic_index_in_dim(x, mb_index, axis=0, keepdims=False)
+            inp = jnp.where(is_first, fresh, carry)
+            y = stage_fn(params, inp)
+            # Only ticks where this stage holds live data matter; dead ticks
+            # compute garbage that is never written out (fill/drain bubbles).
+            done_index = t - (n_stages - 1)
+            write = jnp.logical_and(is_last, done_index >= 0)
+            out = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(done_index, 0, num_microbatches - 1), axis=0
+                ),
+                lambda o: o,
+                out,
+            )
+            perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+            carry_next = lax.ppermute(y, pipe_axis, perm)
+            return carry_next, out
+
+        _, out = lax.fori_loop(0, ticks, tick, (carry0, out0))
+        # Output lives on the last stage only; psum replicates it (all other
+        # stages contribute zeros).
+        return lax.psum(out, pipe_axis)
+
+    param_spec = P(pipe_axis)
+    x_spec = P(None, batch_axes)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )

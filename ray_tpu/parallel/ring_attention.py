@@ -1,0 +1,166 @@
+"""Ring attention — sequence/context parallelism over ICI neighbors.
+
+Absent from the reference entirely (SURVEY §5.7: no ring attention, Ulysses,
+or context-parallel code anywhere in it); on TPU it is first-class: the
+sequence dimension is a mesh axis, K/V blocks rotate around the ``seq`` ring
+via ``ppermute`` (which XLA overlaps with the per-block attention compute on
+ICI), and softmax is accumulated online (log-sum-exp), so attention over a
+sequence of length L runs on P devices each holding L/P — exact, not
+approximate.
+
+Also provides Ulysses-style all-to-all sequence parallelism: swap the
+sharded axis from sequence to heads, run local full attention, swap back —
+the better choice when head count ≥ ring size and DCN spans make ppermute
+latency-bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, *, scale, mask):
+    """One (q-block, kv-block) attention contribution in f32.
+
+    q: [B, Lq, H, D]  k/v: [B, Lk, H, D]  mask: [Lq, Lk] additive or None.
+    Returns (scores_max [B,H,Lq], exp_scores [B,H,Lq,Lk], pv [B,H,Lq,D]).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if mask is not None:
+        scores = scores + mask[None, None, :, :]
+    block_max = jnp.max(scores, axis=-1)
+    exp_scores = jnp.exp(scores - block_max[..., None])
+    pv = jnp.einsum("bhqk,bkhd->bhqd", exp_scores, v.astype(jnp.float32))
+    return block_max, exp_scores, pv
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int, causal: bool, scale: Optional[float]):
+    """Per-device body under shard_map. Shapes are local blocks:
+    q [B, Lq, H, D], k/v [B, Lk, H, D], sharded along L on ``axis_name``."""
+    orig_dtype = q.dtype
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    my_idx = lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32)
+    m0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+
+    q_pos = my_idx * lq + jnp.arange(lq)
+
+    def step(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size  # which device this kv came from
+        if causal:
+            k_pos = src_idx * lk + jnp.arange(lk)
+            mask = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, _NEG_INF)
+        else:
+            mask = None
+        block_max, exp_scores, pv = _block_attention(
+            q32, k_cur, v_cur, scale=scale, mask=mask
+        )
+        new_m = jnp.maximum(m, block_max)
+        # Guard fully-masked blocks: block_max = NEG_INF there; keep exact 0
+        # contribution without NaNs from (-inf) - (-inf).
+        corr = jnp.exp(m - new_m)
+        block_corr = jnp.exp(block_max - new_m)
+        l_new = l * corr + jnp.sum(exp_scores, axis=-1) * block_corr
+        o_new = o * corr[..., None] + pv * block_corr[..., None]
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return new_m, l_new, o_new, k_next, v_next
+
+    m, l, o, _, _ = lax.fori_loop(0, axis_size, step, (m0, l0, o0, k, v))
+    # Rows with zero mass (fully masked everywhere) produce 0, not NaN.
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(orig_dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+):
+    """Build a jittable ring-attention over ``mesh``.
+
+    Input/output layout: [batch, seq, heads, head_dim] with batch sharded on
+    ``batch_axes``, seq on ``seq_axis`` and heads on ``head_axis`` (heads and
+    ring compose: each device holds a (seq-block × head-group)).
+    """
+    axis_size = mesh.shape[seq_axis]
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    body = functools.partial(
+        _ring_attention_local,
+        axis_name=seq_axis,
+        axis_size=axis_size,
+        causal=causal,
+        scale=scale,
+    )
+    return jax.shard_map(
+        lambda q, k, v: body(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def reference_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
+    """Plain full attention (single device) — numerical oracle for tests."""
+    b, l, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((l, l), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+):
+    """Ulysses sequence parallelism: all_to_all swaps the sharded dim from
+    sequence to heads, each device runs full-sequence attention on its head
+    group, and a second all_to_all swaps back. Requires heads % ring == 0."""
+    axis_size = mesh.shape[seq_axis]
+    spec = P(batch_axes, seq_axis, None, None)
+
+    def body(q, k, v):
+        # local [B, L/P, H, D] -> [B, L, H/P, D]
+        def seq_to_heads(x):
+            return lax.all_to_all(x, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            return lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        out = reference_attention(qh, kh, vh, causal=causal, scale=scale)
+        return heads_to_seq(out)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )

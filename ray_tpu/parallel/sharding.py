@@ -1,0 +1,108 @@
+"""Logical-axis sharding rules: model code names *logical* axes, a rule table
+maps them onto mesh axes.
+
+This is the TPU-native replacement for everything the reference delegates to
+DeepSpeed/Megatron (SURVEY §2.4: TP/PP/SP "not implemented in Ray" — reached
+only via launched frameworks). Model parameters and activations are annotated
+with logical axis names (``("embed", "mlp")``); a ``ShardingRules`` table maps
+each logical name to a mesh axis (or None = replicate); ``jax.jit`` +
+``NamedSharding`` then compiles in all collectives.
+
+Default rules implement the standard megatron/fsdp recipe:
+- ``vocab``/``mlp``/``heads`` → ``tensor`` (column/row parallel matmuls)
+- ``embed`` → ``fsdp`` (parameter sharding, all-gathered on use)
+- ``batch`` → ``data``+``fsdp`` (per-device batch)
+- ``seq_act`` → ``seq`` (sequence/context parallelism for activations)
+- ``layers`` → ``pipe`` (pipeline stage stacking)
+- ``experts`` → ``expert``
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxis = Union[str, Tuple[str, ...], None]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    batch: MeshAxis = ("data", "fsdp")
+    seq_act: MeshAxis = "seq"          # activation sequence dim
+    embed: MeshAxis = "fsdp"           # parameter d_model dim (fsdp-sharded)
+    mlp: MeshAxis = "tensor"           # ffn hidden dim
+    heads: MeshAxis = "tensor"         # attention heads
+    kv_heads: MeshAxis = "tensor"
+    vocab: MeshAxis = "tensor"
+    head_dim: MeshAxis = None
+    layers: MeshAxis = "pipe"
+    experts: MeshAxis = "expert"
+    unsharded: MeshAxis = None
+
+    def mesh_axes(self, logical: Optional[Tuple[Optional[str], ...]]) -> PartitionSpec:
+        """Translate a tuple of logical names to a PartitionSpec."""
+        if logical is None:
+            return PartitionSpec()
+        out = []
+        for name in logical:
+            if name is None:
+                out.append(None)
+            else:
+                if not hasattr(self, name):
+                    raise ValueError(f"unknown logical axis '{name}'")
+                out.append(getattr(self, name))
+        return PartitionSpec(*out)
+
+    def update(self, **kwargs) -> "ShardingRules":
+        return replace(self, **kwargs)
+
+
+# Rule presets for common topologies.
+DP_ONLY = ShardingRules(
+    batch="data", seq_act=None, embed=None, mlp=None, heads=None,
+    kv_heads=None, vocab=None, layers=None, experts=None,
+)
+FSDP = ShardingRules(
+    batch=("data", "fsdp"), seq_act=None, mlp=None, heads=None,
+    kv_heads=None, vocab=None, layers=None, experts=None,
+)
+
+
+def logical_sharding(
+    mesh: Mesh, rules: ShardingRules, logical: Optional[Tuple[Optional[str], ...]]
+) -> NamedSharding:
+    spec = rules.mesh_axes(logical)
+    # Drop mesh axes the array dim isn't divisible by? No — surface the error;
+    # divisibility is a model-config contract (pad vocab etc.).
+    return NamedSharding(mesh, spec)
+
+
+def shard_pytree(tree, logical_tree, mesh: Mesh, rules: ShardingRules):
+    """Device-put a pytree of arrays under its logical annotations.
+
+    ``logical_tree`` mirrors ``tree`` with tuples of logical axis names (or
+    None) at the leaves.
+    """
+
+    def place(x, logical):
+        return jax.device_put(x, logical_sharding(mesh, rules, logical))
+
+    return jax.tree.map(place, tree, logical_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def pytree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules):
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    return jax.tree.map(
+        lambda logical: logical_sharding(mesh, rules, logical),
+        logical_tree,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)),
+    )
+
+
+def constrain(x, mesh: Mesh, rules: ShardingRules, logical: Tuple[Optional[str], ...]):
+    """with_sharding_constraint under logical names (inside jit)."""
+    return jax.lax.with_sharding_constraint(x, logical_sharding(mesh, rules, logical))

@@ -23,6 +23,40 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import pytest  # noqa: E402
 
+import jax  # noqa: E402
+
+# The axon TPU plugin force-registers itself as default platform regardless of
+# JAX_PLATFORMS; pin all test computation to the virtual CPU devices and full
+# matmul precision so numerical oracles are exact.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+TEST_TIMEOUT_S = 180  # matches the reference's pytest.ini per-test timeout
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout():
+    """Hang protection for a condition-variable-heavy runtime: SIGALRM raises
+    in the main thread if a test exceeds the budget (pytest-timeout is not in
+    the image)."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {TEST_TIMEOUT_S}s (possible deadlock)")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
 
 @pytest.fixture
 def ray_start_regular():
