@@ -1,0 +1,24 @@
+from ray_tpu.models import mlp, transformer
+from ray_tpu.models.training import TrainStepBundle, make_eval_step, make_train_step
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    gpt2_large,
+    gpt2_medium,
+    gpt2_small,
+    gpt2_xl,
+    tiny,
+)
+
+__all__ = [
+    "mlp",
+    "transformer",
+    "TransformerConfig",
+    "gpt2_small",
+    "gpt2_medium",
+    "gpt2_large",
+    "gpt2_xl",
+    "tiny",
+    "make_train_step",
+    "make_eval_step",
+    "TrainStepBundle",
+]

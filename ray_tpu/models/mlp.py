@@ -1,0 +1,52 @@
+"""Small MLP classifier — the MNIST e2e gate model (SURVEY §7 P4 gate #1)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (128, 128)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(config: MLPConfig, key: jax.Array) -> Dict:
+    dims = (config.in_dim,) + config.hidden + (config.n_classes,)
+    keys = jax.random.split(key, len(dims) - 1)
+    layers = []
+    for k, (a, b) in zip(keys, zip(dims[:-1], dims[1:])):
+        w = jax.random.normal(k, (a, b), jnp.float32) * (2.0 / a) ** 0.5
+        layers.append({"w": w.astype(config.dtype), "b": jnp.zeros((b,), config.dtype)})
+    return {"layers": layers}
+
+
+def logical_axes(config: MLPConfig) -> Dict:
+    n = len(config.hidden) + 1
+    return {"layers": [{"w": (None, "mlp"), "b": ("mlp",)} if i < n - 1
+                       else {"w": ("mlp", None), "b": (None,)}
+                       for i in range(n)]}
+
+
+def forward(params: Dict, x: jax.Array, config: MLPConfig) -> jax.Array:
+    h = x.astype(config.dtype)
+    for i, layer in enumerate(params["layers"]):
+        h = jnp.einsum("bd,df->bf", h, layer["w"], preferred_element_type=jnp.float32)
+        h = (h + layer["b"].astype(jnp.float32)).astype(config.dtype)
+        if i < len(params["layers"]) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def classifier_loss(params: Dict, batch: Dict, config: MLPConfig):
+    logits = forward(params, batch["x"], config).astype(jnp.float32)
+    labels = batch["y"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)

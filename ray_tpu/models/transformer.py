@@ -1,0 +1,285 @@
+"""Decoder-only transformer LM (GPT-2 family), TPU-shaped.
+
+This is the flagship model for the Train north-star (BASELINE.json: GPT-2-124M
+tokens/sec/chip). The reference has no model code of its own — it orchestrates
+torch models (e.g. ``release/air_tests/air_benchmarks/workloads/``); here the
+model is a first-class citizen designed for the MXU:
+
+- params are plain pytrees; blocks are STACKED on a leading ``layers`` dim and
+  the forward pass is a single ``lax.scan`` — one compiled block body, weight
+  gathers pipelined by XLA, and the natural layout for pipeline parallelism
+  (``layers`` → ``pipe`` mesh axis).
+- every parameter and activation carries *logical* axis names resolved
+  through ``parallel.sharding.ShardingRules`` — the same model runs DP, FSDP,
+  megatron TP, sequence-parallel or any mix by swapping the rule table,
+  never editing model code.
+- compute dtype bf16 with f32 accumulation (matmul ``preferred_element_type``,
+  f32 layernorm stats/softmax/loss); params kept in f32 by default (optimizer
+  numerics), cast to bf16 at use.
+- vocab padded to a multiple of 128 so the logits matmul tiles the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.layers import gelu, layer_norm, linear, rope, softmax_cross_entropy
+from ray_tpu.parallel.mesh import Mesh
+from ray_tpu.parallel.sharding import ShardingRules, constrain
+
+
+def pad_vocab(n: int, multiple: int = 128) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32     # storage dtype
+    pos: str = "learned"               # "learned" (gpt2) | "rope" (llama-ish)
+    tie_embeddings: bool = True
+    attn_impl: str = "dense"           # "dense" | "ring" | "ulysses"
+    remat: bool = False                # jax.checkpoint each block (HBM↔FLOPs)
+    vocab_multiple: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        return pad_vocab(self.vocab_size, self.vocab_multiple)
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return replace(self, **kw)
+
+
+def gpt2_small(**kw) -> TransformerConfig:
+    """GPT-2 124M."""
+    return TransformerConfig(**kw)
+
+
+def gpt2_medium(**kw) -> TransformerConfig:
+    return TransformerConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096, **kw)
+
+
+def gpt2_large(**kw) -> TransformerConfig:
+    return TransformerConfig(d_model=1280, n_layers=36, n_heads=20, d_ff=5120, **kw)
+
+
+def gpt2_xl(**kw) -> TransformerConfig:
+    return TransformerConfig(d_model=1600, n_layers=48, n_heads=25, d_ff=6400, **kw)
+
+
+def tiny(**kw) -> TransformerConfig:
+    """Test-sized config (runs in ms on CPU)."""
+    defaults = dict(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32, vocab_multiple=8,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def init_params(config: TransformerConfig, key: jax.Array) -> Dict:
+    """GPT-2 init: normal(0.02), residual projections scaled by 1/sqrt(2N)."""
+    c = config
+    k = iter(jax.random.split(key, 16))
+    dt = c.param_dtype
+    std = 0.02
+    res_std = std / (2 * c.n_layers) ** 0.5
+
+    def nrm(key, shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+    L, D, H, Dh, F, V = c.n_layers, c.d_model, c.n_heads, c.head_dim, c.d_ff, c.padded_vocab
+
+    blocks = {
+        "ln1_g": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+        "wq": nrm(next(k), (L, D, H, Dh)), "wk": nrm(next(k), (L, D, H, Dh)),
+        "wv": nrm(next(k), (L, D, H, Dh)),
+        "wo": nrm(next(k), (L, H, Dh, D), res_std),
+        "bq": jnp.zeros((L, H, Dh), dt), "bk": jnp.zeros((L, H, Dh), dt),
+        "bv": jnp.zeros((L, H, Dh), dt), "bo": jnp.zeros((L, D), dt),
+        "ln2_g": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+        "w_up": nrm(next(k), (L, D, F)), "b_up": jnp.zeros((L, F), dt),
+        "w_down": nrm(next(k), (L, F, D), res_std), "b_down": jnp.zeros((L, D), dt),
+    }
+    params = {
+        "tok_embed": nrm(next(k), (V, D)),
+        "blocks": blocks,
+        "lnf_g": jnp.ones((D,), dt), "lnf_b": jnp.zeros((D,), dt),
+    }
+    if c.pos == "learned":
+        params["pos_embed"] = nrm(next(k), (c.max_seq_len, D), 0.01)
+    if not c.tie_embeddings:
+        params["lm_head"] = nrm(next(k), (D, V))
+    return params
+
+
+def logical_axes(config: TransformerConfig) -> Dict:
+    """Pytree of logical axis names mirroring ``init_params`` output."""
+    c = config
+    blocks = {
+        "ln1_g": ("layers", "embed"), "ln1_b": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "bq": ("layers", "heads", "head_dim"), "bk": ("layers", "kv_heads", "head_dim"),
+        "bv": ("layers", "kv_heads", "head_dim"), "bo": ("layers", "embed"),
+        "ln2_g": ("layers", "embed"), "ln2_b": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"), "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"), "b_down": ("layers", "embed"),
+    }
+    axes = {
+        "tok_embed": ("vocab", "embed"),
+        "blocks": blocks,
+        "lnf_g": ("embed",), "lnf_b": ("embed",),
+    }
+    if c.pos == "learned":
+        axes["pos_embed"] = (None, "embed")
+    if not c.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def param_count(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _dense_attention(q, k, v, *, scale: float):
+    """Causal full attention in f32. q/k/v: [B, L, H, Dh]."""
+    l = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    mask = jnp.tril(jnp.ones((l, l), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _make_attention(config: TransformerConfig, mesh: Optional[Mesh]):
+    scale = 1.0 / config.head_dim ** 0.5
+    if config.attn_impl == "dense" or mesh is None:
+        return functools.partial(_dense_attention, scale=scale)
+    if config.attn_impl == "ring":
+        from ray_tpu.parallel.ring_attention import make_ring_attention
+
+        return make_ring_attention(mesh, causal=True, scale=scale)
+    if config.attn_impl == "ulysses":
+        from ray_tpu.parallel.ring_attention import make_ulysses_attention
+
+        return make_ulysses_attention(mesh, causal=True, scale=scale)
+    raise ValueError(f"unknown attn_impl {config.attn_impl!r}")
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """tokens [B, L] int32 → logits [B, L, padded_vocab] (compute dtype).
+
+    When ``mesh``+``rules`` are provided, activations carry sharding
+    constraints so XLA places the megatron collectives exactly where the
+    recipe wants them (after attention out-proj / mlp down-proj).
+    """
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+
+    def cstr(x, logical):
+        if mesh is not None and rules is not None:
+            return constrain(x, mesh, rules, logical)
+        return x
+
+    B, L = tokens.shape
+    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    positions = jnp.arange(L)
+    if c.pos == "learned":
+        h = h + cast(params["pos_embed"])[positions]
+    h = cstr(h, ("batch", "seq_act", None))
+
+    attention = _make_attention(c, mesh)
+
+    def block(h, bp):
+        bp = jax.tree.map(cast, bp)
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("bld,dhk->blhk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        kk = jnp.einsum("bld,dhk->blhk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        vv = jnp.einsum("bld,dhk->blhk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            q = rope(q, positions)
+            kk = rope(kk, positions)
+        q = cstr(q, ("batch", "seq_act", "heads", "head_dim"))
+        kk = cstr(kk, ("batch", "seq_act", "kv_heads", "head_dim"))
+        vv = cstr(vv, ("batch", "seq_act", "kv_heads", "head_dim"))
+        o = attention(q, kk, vv)
+        o = jnp.einsum("blhk,hkd->bld", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
+        h = cstr(h + o, ("batch", "seq_act", None))
+
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = linear(x, bp["w_up"], bp["b_up"])
+        u = cstr(gelu(u), ("batch", "seq_act", "mlp"))
+        d = linear(u, bp["w_down"], bp["b_down"])
+        h = cstr(h + d, ("batch", "seq_act", None))
+        return h, None
+
+    block_fn = jax.checkpoint(block) if c.remat else block
+    h, _ = lax.scan(block_fn, h, params["blocks"])
+
+    h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
+    w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bld,dv->blv", h, cast(w_out), preferred_element_type=jnp.float32)
+    logits = cstr(logits.astype(c.dtype), ("batch", "seq_act", "vocab"))
+    return logits
+
+
+def lm_loss(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+):
+    """Next-token LM loss. batch: {"tokens": [B, L]} (optionally "loss_mask").
+
+    Positions beyond ``config.vocab_size`` (the pad region) never receive
+    probability mass pressure from real labels; the pad logits train to -inf
+    naturally.
+    """
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, config, mesh=mesh, rules=rules)
+    labels = jnp.where(
+        batch.get("loss_mask", jnp.ones_like(tokens))[:, 1:] > 0,
+        tokens[:, 1:],
+        -100,
+    )
+    loss, n = softmax_cross_entropy(logits[:, :-1], labels)
+    return loss
