@@ -1,0 +1,102 @@
+"""Headline benchmark: GPT-2-124M training throughput, tokens/sec/chip.
+
+Runs the full sharded train step (forward+backward+adamw, bf16 compute) on
+whatever devices are available — the real TPU chip under the driver, or the
+virtual CPU mesh locally — and prints ONE JSON line.
+
+``vs_baseline``: the north star (BASELINE.md) is ≥0.8× per-chip vs an
+H100+NCCL torch baseline. No such number is published in-repo
+(BASELINE.json ``published: {}``); we use a conservative reference point of
+60k tokens/sec/chip for GPT-2-124M-class training on an H100 (bf16, torch
+compile-class efficiency) so the ratio is meaningful and stable across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+H100_GPT2_TOKENS_PER_SEC_PER_CHIP = 60_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import transformer
+    from ray_tpu.models.training import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, best_devices, make_mesh
+    from ray_tpu.parallel.sharding import ShardingRules
+
+    devices = best_devices()
+    n = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+
+    # Data-parallel over every chip; single chip → trivial mesh.
+    mesh = make_mesh(MeshSpec(data=-1), devices=devices)
+    rules = ShardingRules()
+
+    if on_tpu:
+        cfg = transformer.gpt2_small(max_seq_len=1024, remat=True)
+        batch_per_chip, seq = 8, 1024
+        steps, warmup = 20, 3
+    else:
+        # CPU smoke shape: same code path, tiny sizes.
+        cfg = transformer.tiny(max_seq_len=256, n_layers=2)
+        batch_per_chip, seq = 2, 256
+        steps, warmup = 5, 1
+
+    bundle = make_train_step(
+        loss_fn=lambda p, b: transformer.lm_loss(p, b, cfg, mesh=mesh, rules=rules),
+        init_params_fn=lambda k: transformer.init_params(cfg, k),
+        logical_params=transformer.logical_axes(cfg),
+        mesh=mesh,
+        rules=rules,
+        optimizer=optax.adamw(3e-4, weight_decay=0.1),
+        batch_logical=("batch", None),
+    )
+    params, opt_state = bundle.init(jax.random.key(0))
+
+    global_batch = batch_per_chip * n
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jax.device_put(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (global_batch, seq)), jnp.int32),
+            bundle.batch_sharding,
+        )
+    }
+
+    for _ in range(warmup):
+        params, opt_state, metrics = bundle.step(params, opt_state, batch)
+    float(metrics["loss"])  # host fetch: hard sync (block_until_ready alone
+    # does not drain the axon tunnel's async dispatch)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = bundle.step(params, opt_state, batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = global_batch * seq * steps / dt
+    per_chip = tokens_per_sec / n
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_train_tokens_per_sec_per_chip"
+                if on_tpu
+                else "gpt2_train_tokens_per_sec_per_chip_cpu_smoke",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / H100_GPT2_TOKENS_PER_SEC_PER_CHIP, 4),
+                "devices": n,
+                "platform": devices[0].platform,
+                "loss": round(float(metrics["loss"]), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""ray_tpu.train — distributed training on TPU meshes.
+
+Public surface mirrors ``ray.train``: trainers + ScalingConfig/RunConfig +
+session (``report``/``get_context``/``get_checkpoint``) + ``Checkpoint``.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.checkpoint import (
+    AsyncCheckpointer,
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    restore_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.session import (
+    TrainContext,
+    TrainingResult,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "BackendExecutor",
+    "TrainingFailedError",
+    "Checkpoint",
+    "CheckpointManager",
+    "AsyncCheckpointer",
+    "save_pytree",
+    "load_pytree",
+    "restore_pytree",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "TrainContext",
+    "TrainingResult",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+    "WorkerGroup",
+]

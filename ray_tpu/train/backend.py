@@ -1,0 +1,141 @@
+"""Training backends — per-framework worker-group setup hooks.
+
+Analog of the reference's ``python/ray/train/backend.py`` (``Backend`` :16,
+``BackendConfig`` :32) and its torch implementation
+(``train/torch/config.py:34 TorchConfig`` → NCCL process group): a backend
+gets ``on_start``/``on_training_start``/``on_shutdown`` hooks against the
+WorkerGroup.
+
+``JaxConfig`` is the TPU-native flagship (the ``JaxTrainer = new Backend
+subclass initializing jax.distributed + pjit`` insertion point SURVEY §2.3
+calls out): rank 0's host address is broadcast as the coordinator, every
+worker calls ``jax.distributed.initialize(coordinator, num_processes,
+process_id)``, and device compute then uses the global mesh. In single-process
+clusters (tests; one TPU VM) initialization is skipped — ``jax.devices()``
+already sees every local chip — matching JAX semantics where single-host needs
+no coordination service.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks mirroring the reference's ``Backend`` lifecycle."""
+
+    share_cuda_visible_devices: bool = False  # n/a on TPU; kept for API parity
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """TPU/JAX backend config.
+
+    coordinator_port: port for jax.distributed's coordination service.
+    init_distributed: force-enable/disable ``jax.distributed.initialize``
+        (default: only when the group spans >1 process/host).
+    collective_group: also register an eager (host-side) collective group for
+        the workers (``ray_tpu.parallel.collectives``) — the analog of
+        ``ray.util.collective`` groups, used for small host-side tensors;
+        device tensors always go through XLA collectives inside jit.
+    """
+
+    coordinator_port: int = 8476
+    init_distributed: Optional[bool] = None
+    collective_group: Optional[str] = "train"
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _setup_jax_worker(coordinator: str, num_processes: int, process_id: int, enable: bool):
+    """Runs on every train worker (reference analog:
+    ``_setup_torch_process_group`` ``train/torch/config.py:64-100``)."""
+    if enable:
+        os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+        os.environ["JAX_NUM_PROCESSES"] = str(num_processes)
+        os.environ["JAX_PROCESS_ID"] = str(process_id)
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return True
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig) -> None:
+        # Multi-process only when workers actually live in different processes
+        # (real multi-host). In the in-process runtime all actors share one
+        # JAX client, so initialize() must not run.
+        hosts = {md.hostname for md in worker_group.metadatas}
+        multiproc = len(hosts) > 1
+        enable = (
+            backend_config.init_distributed
+            if backend_config.init_distributed is not None
+            else multiproc
+        )
+        coordinator = f"{worker_group.metadatas[0].hostname}:{backend_config.coordinator_port}"
+        worker_group.execute(
+            lambda rank=None: None
+        )  # barrier: all actors constructed
+        results = [
+            worker_group.execute_single_async(
+                i, _setup_jax_worker, coordinator, worker_group.num_workers, i, enable
+            )
+            for i in range(worker_group.num_workers)
+        ]
+        import ray_tpu
+
+        ray_tpu.get(results)
+
+        if backend_config.collective_group:
+            from ray_tpu.parallel import collectives
+
+            group = backend_config.collective_group
+            n = worker_group.num_workers
+
+            def join(rank, world, name):
+                from ray_tpu.parallel import collectives as c
+
+                c.init_collective_group(world, rank, group_name=name)
+                return True
+
+            ray_tpu.get(
+                [
+                    worker_group.execute_single_async(i, join, i, n, group)
+                    for i in range(n)
+                ]
+            )
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig) -> None:
+        # Driver-side destroy: going through the workers would queue behind
+        # still-running train loops and block shutdown indefinitely.
+        if backend_config.collective_group:
+            from ray_tpu.parallel import collectives
+
+            try:
+                collectives.destroy_collective_group(backend_config.collective_group)
+            except Exception:
+                pass

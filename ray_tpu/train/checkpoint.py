@@ -1,0 +1,249 @@
+"""Checkpoints — directory-backed, pytree-aware.
+
+Analog of the reference's ``ray.train.Checkpoint`` + ``CheckpointManager``
+(``python/ray/train/_internal/checkpoint_manager.py``, ``storage.py``): a
+checkpoint IS a directory; ``report(..., checkpoint=)`` persists it under the
+run's storage path; the manager tracks top-k by a score attribute.
+
+Pytrees of jax/numpy arrays are stored as one ``.npz`` (arrays) plus a JSON
+treedef — no pickle on the array path, and save is host-side so a TPU training
+loop can overlap the next step with the write (async flavor in
+``AsyncCheckpointer``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+
+class Checkpoint:
+    """A checkpoint is a directory (reference: ``ray.train.Checkpoint``)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        save_pytree(data, d)
+        return cls(d)
+
+    # -- accessors ----------------------------------------------------------
+    def to_directory(self) -> str:
+        return self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        return load_pytree(self.path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+
+def _host_leaf(x):
+    if isinstance(x, jax.Array):
+        return np.asarray(jax.device_get(x))
+    return x
+
+
+def save_pytree(tree: Any, directory: str, *, name: str = "state") -> str:
+    """Write a pytree of arrays/scalars to ``directory``.
+
+    Arrays → ``{name}.npz`` keyed by flattened index; structure + non-array
+    leaves → ``{name}.tree.json``.
+    """
+    os.makedirs(directory, exist_ok=True)
+    # None counts as a leaf (is_leaf) so the JSON skeleton's leaf indices stay
+    # aligned with the flatten order — jax.tree.flatten would otherwise prune
+    # None and desynchronize the npz keys.
+    host = jax.tree.map(_host_leaf, tree, is_leaf=lambda x: x is None)
+    leaves, treedef = jax.tree.flatten(host, is_leaf=lambda x: x is None)
+    arrays: Dict[str, np.ndarray] = {}
+    meta: List[Dict] = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (np.ndarray, np.generic)):
+            arrays[str(i)] = np.asarray(leaf)
+            meta.append({"kind": "array"})
+        elif isinstance(leaf, (int, float, bool, str, type(None))):
+            meta.append({"kind": "json", "value": leaf})
+        else:
+            raise TypeError(f"unsupported checkpoint leaf type {type(leaf)}")
+    np.savez(os.path.join(directory, f"{name}.npz"), **arrays)
+    with open(os.path.join(directory, f"{name}.tree.json"), "w") as f:
+        json.dump({"structure": _treedef_to_json(tree), "leaves": meta}, f)
+    return directory
+
+
+def _treedef_to_json(tree) -> Any:
+    """JSON skeleton with leaf positions as {"__leaf__": i}."""
+    counter = [0]
+
+    def rec(node):
+        if isinstance(node, dict):
+            if any(not isinstance(k, str) for k in node):
+                raise TypeError(
+                    f"checkpoint dict keys must be str, got {list(node)[:4]}"
+                )
+            return {"__dict__": {k: rec(node[k]) for k in sorted(node)}}
+        if isinstance(node, (list, tuple)):
+            tag = "__list__" if isinstance(node, list) else "__tuple__"
+            return {tag: [rec(v) for v in node]}
+        i = counter[0]
+        counter[0] += 1
+        return {"__leaf__": i}
+
+    return rec(tree)
+
+
+def _json_to_tree(skel, leaves: List[Any]) -> Any:
+    def rec(node):
+        if "__leaf__" in node:
+            return leaves[node["__leaf__"]]
+        if "__dict__" in node:
+            return {k: rec(v) for k, v in node["__dict__"].items()}
+        if "__list__" in node:
+            return [rec(v) for v in node["__list__"]]
+        if "__tuple__" in node:
+            return tuple(rec(v) for v in node["__tuple__"])
+        raise ValueError(f"bad checkpoint skeleton node: {node}")
+
+    return rec(skel)
+
+
+def load_pytree(directory: str, *, name: str = "state") -> Any:
+    with open(os.path.join(directory, f"{name}.tree.json")) as f:
+        spec = json.load(f)
+    npz = np.load(os.path.join(directory, f"{name}.npz"))
+    leaves: List[Any] = []
+    ai = 0
+    for i, m in enumerate(spec["leaves"]):
+        if m["kind"] == "array":
+            leaves.append(npz[str(i)])
+        else:
+            leaves.append(m["value"])
+    return _json_to_tree(spec["structure"], leaves)
+
+
+def restore_pytree(target: Any, directory: str, *, name: str = "state") -> Any:
+    """Load leaves into the STRUCTURE of ``target`` (exact container types —
+    NamedTuple optimizer states etc. — are preserved, unlike ``load_pytree``
+    which returns plain dicts/lists/tuples)."""
+    leaves, treedef = jax.tree.flatten(target, is_leaf=lambda x: x is None)
+    with open(os.path.join(directory, f"{name}.tree.json")) as f:
+        spec = json.load(f)
+    npz = np.load(os.path.join(directory, f"{name}.npz"))
+    loaded: List[Any] = []
+    for i, m in enumerate(spec["leaves"]):
+        loaded.append(npz[str(i)] if m["kind"] == "array" else m["value"])
+    if len(loaded) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(loaded)} leaves but target expects {len(leaves)}"
+        )
+    return jax.tree.unflatten(treedef, loaded)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint writes with training (orbax-style async save):
+    ``save`` snapshots to host memory synchronously (cheap) and writes on a
+    background thread; ``wait`` joins the in-flight write."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, tree: Any, directory: str) -> None:
+        host_tree = jax.tree.map(_host_leaf, tree, is_leaf=lambda x: x is None)
+        self.wait()
+
+        def run():
+            try:
+                save_pytree(host_tree, directory)
+            except BaseException as e:  # surfaced from wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+@dataclass(order=True)
+class _TrackedCheckpoint:
+    score: float
+    index: int
+    checkpoint: "Checkpoint" = field(compare=False)
+    metrics: Dict = field(compare=False, default_factory=dict)
+
+
+class CheckpointManager:
+    """Top-k retention (reference: ``_internal/checkpoint_manager.py``)."""
+
+    def __init__(self, storage_path: str, config: Optional["CheckpointConfig"] = None):
+        from ray_tpu.train.config import CheckpointConfig
+
+        self.storage_path = storage_path
+        self.config = config or CheckpointConfig()
+        self._tracked: List[_TrackedCheckpoint] = []
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict) -> Checkpoint:
+        """Persist ``checkpoint`` into storage and apply retention."""
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, dest)
+        persisted = Checkpoint(dest)
+
+        attr = self.config.checkpoint_score_attribute
+        if attr is not None and attr in metrics:
+            score = float(metrics[attr])
+            if self.config.checkpoint_score_order == "min":
+                score = -score
+        else:
+            score = float(self._index)  # recency
+        self._tracked.append(_TrackedCheckpoint(score, self._index, persisted, dict(metrics)))
+        self._index += 1
+
+        k = self.config.num_to_keep
+        if k is not None and len(self._tracked) > k:
+            self._tracked.sort()
+            evicted = self._tracked.pop(0)
+            shutil.rmtree(evicted.checkpoint.path, ignore_errors=True)
+        return persisted
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked).checkpoint
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    def checkpoints(self) -> List[Tuple[Checkpoint, Dict]]:
+        return [(t.checkpoint, t.metrics) for t in sorted(self._tracked, key=lambda t: t.index)]

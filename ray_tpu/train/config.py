@@ -1,0 +1,68 @@
+"""Run/scaling configuration dataclasses.
+
+Analog of the reference's ``python/ray/air/config.py`` (``ScalingConfig``,
+``RunConfig``, ``FailureConfig``, ``CheckpointConfig``) with TPU-first
+resource semantics: a worker claims whole chips (``tpus_per_worker``) or a
+whole slice via the slice-head resource, mirroring the accelerator registry's
+``TPU-{pod_type}-head`` convention
+(reference: ``python/ray/_private/accelerators/tpu.py:363-382``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """Reference: ``air/config.py ScalingConfig``."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU-native extension: claim a whole slice per worker through its
+    # head resource (one worker process per host, jax.distributed world).
+    topology: Optional[str] = None  # e.g. "v5e-16"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            res = dict(self.resources_per_worker)
+            res.setdefault("CPU", self.cpus_per_worker)
+            return res
+        res: Dict[str, float] = {"CPU": self.cpus_per_worker}
+        if self.use_tpu or self.tpus_per_worker:
+            res["TPU"] = self.tpus_per_worker or 1.0
+        if self.topology:
+            res[f"TPU-{self.topology}-head"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Reference: ``air/config.py FailureConfig``."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: ``air/config.py CheckpointConfig`` (keep-top-k)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+
+@dataclass
+class RunConfig:
+    """Reference: ``air/config.py RunConfig``."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
