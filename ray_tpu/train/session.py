@@ -42,7 +42,9 @@ class TrainContext:
         result_queue: Optional[queue.Queue] = None,
         checkpoint: Optional[Checkpoint] = None,
         stop_event: Optional[threading.Event] = None,
+        report_fn=None,  # overrides the queue path (Tune's per-report hook)
     ):
+        self._report_fn = report_fn
         self._world_rank = world_rank
         self._world_size = world_size
         self._local_rank = local_rank
@@ -107,11 +109,15 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) 
     driver collects one report per worker before proceeding.
     """
     ctx = get_context()
+    result = TrainingResult(
+        metrics=dict(metrics), checkpoint=checkpoint, world_rank=ctx._world_rank
+    )
+    if getattr(ctx, "_report_fn", None) is not None:
+        ctx._report_fn(result)
+        return
     if ctx._result_queue is None:
         return  # standalone mode: no-op
-    ctx._result_queue.put(
-        TrainingResult(metrics=dict(metrics), checkpoint=checkpoint, world_rank=ctx._world_rank)
-    )
+    ctx._result_queue.put(result)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -1,0 +1,58 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Public surface mirrors ``ray.tune``: Tuner/run, search spaces, searchers,
+schedulers (ASHA/PBT/median-stopping), report/get_checkpoint shared with
+ray_tpu.train (the reference unified these under ray.train in 2.x).
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.tune.experiment import Trial, TrialStatus
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "run",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "Checkpoint",
+    "Trial",
+    "TrialStatus",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Searcher",
+    "BasicVariantGenerator",
+    "uniform",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "choice",
+    "grid_search",
+    "sample_from",
+]

@@ -1,0 +1,213 @@
+"""Trial schedulers — FIFO, ASHA, median-stopping, PBT.
+
+Analog of the reference's ``python/ray/tune/schedulers/``:
+``async_hyperband.py`` (ASHA), ``median_stopping_rule.py``, ``pbt.py``. The
+controller feeds every trial result through ``on_trial_result``; the scheduler
+answers CONTINUE/STOP (and for PBT, a clone-and-perturb restart decision
+carried out by the controller).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu.tune.experiment import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    RESTART = "RESTART"  # PBT exploit: restart with mutated config+checkpoint
+
+    def set_metric(self, metric: str, mode: str) -> None:
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial: "Trial", result: Dict) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial: "Trial", result: Optional[Dict]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference default)."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    ``tune/schedulers/async_hyperband.py``).
+
+    Rung r handles iteration ``grace_period * reduction_factor**r``; a trial
+    reaching a rung is stopped unless it is in the top ``1/reduction_factor``
+    of scores recorded at that rung so far.
+    """
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones ascending
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(int(t))
+            t *= reduction_factor
+        self._rung_scores: Dict[int, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial: "Trial", result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return self.STOP
+        decision = self.CONTINUE
+        for milestone in self.milestones:
+            if t == milestone:
+                scores = self._rung_scores[milestone]
+                score = self._score(result)
+                scores.append(score)
+                k = max(1, int(len(scores) / self.rf))
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    decision = self.STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is below the median of running averages
+    (reference: ``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial: "Trial", result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        score = self._score(result)
+        self._history[trial.trial_id].append(score)
+        if t < self.grace_period or len(self._history) < self.min_samples:
+            return self.CONTINUE
+        means = [sum(v) / len(v) for k, v in self._history.items() if v]
+        median = sorted(means)[len(means) // 2]
+        my_best = max(self._history[trial.trial_id])
+        return self.STOP if my_best < median else self.CONTINUE
+
+
+@dataclass
+class _PbtState:
+    last_perturb_t: int = 0
+    score: Optional[float] = None
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: ``tune/schedulers/pbt.py``): at each
+    ``perturbation_interval``, bottom-quantile trials exploit (copy config +
+    checkpoint from a top-quantile trial) and explore (mutate hyperparams).
+
+    The controller executes the RESTART decision: it stops the trial actor and
+    respawns it with ``trial.config`` (already mutated here) and
+    ``trial.restore_checkpoint`` (the donor's latest reported checkpoint).
+    """
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._state: Dict[str, _PbtState] = defaultdict(_PbtState)
+        self._trials: Dict[str, "Trial"] = {}
+
+    def _quantiles(self):
+        scored = [(tid, st.score) for tid, st in self._state.items() if st.score is not None]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda kv: kv[1])
+        n = max(1, int(len(scored) * self.quantile))
+        bottom = [tid for tid, _ in scored[:n]]
+        top = [tid for tid, _ in scored[-n:]]
+        return bottom, top
+
+    def _mutate(self, config: Dict) -> Dict:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self.rng)
+                elif isinstance(spec, list):
+                    out[key] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                if isinstance(out[key], (int, float)) and not isinstance(out[key], bool):
+                    out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_trial_result(self, trial: "Trial", result: Dict) -> str:
+        self._trials[trial.trial_id] = trial
+        st = self._state[trial.trial_id]
+        st.score = self._score(result)
+        t = int(result.get(self.time_attr, 0))
+        if t - st.last_perturb_t < self.interval:
+            return self.CONTINUE
+        st.last_perturb_t = t
+        bottom, top = self._quantiles()
+        if trial.trial_id in bottom and top:
+            donor_id = self.rng.choice(top)
+            donor = self._trials.get(donor_id)
+            if donor is None or donor.latest_checkpoint is None:
+                return self.CONTINUE
+            trial.config = self._mutate(dict(donor.config))
+            trial.restore_checkpoint = donor.latest_checkpoint
+            self._state[trial.trial_id].last_perturb_t = 0
+            return self.RESTART
+        return self.CONTINUE

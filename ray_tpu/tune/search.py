@@ -1,0 +1,226 @@
+"""Search spaces + searchers.
+
+Analog of the reference's ``python/ray/tune/search/`` — sample-space API
+(``tune.uniform/loguniform/choice/randint/grid_search`` from
+``tune/search/sample.py``) and the default ``BasicVariantGenerator``
+(grid × random sampling). Third-party searchers (optuna/hyperopt/...) plug in
+through the same ``Searcher`` interface (``suggest``/``on_trial_complete``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+    base: float = 10.0
+
+    def sample(self, rng):
+        lo, hi = math.log(self.low, self.base), math.log(self.high, self.base)
+        return self.base ** rng.uniform(lo, hi)
+
+
+@dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int  # exclusive
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class RandnDomain(Domain):
+    mean: float = 0.0
+    sd: float = 1.0
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+@dataclass
+class GridSearch:
+    """Marker: expand every value as its own variant (reference:
+    ``tune.grid_search``)."""
+
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float, base: float = 10.0) -> LogUniform:
+    return LogUniform(low, high, base)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> RandnDomain:
+    return RandnDomain(mean, sd)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def sample_from(fn: Callable[[Dict], Any]) -> "SampleFrom":
+    return SampleFrom(fn)
+
+
+@dataclass
+class SampleFrom(Domain):
+    fn: Callable[[Dict], Any]
+
+    def sample(self, rng):  # resolved against the partial config by the generator
+        raise RuntimeError("SampleFrom is resolved by the variant generator")
+
+
+# ---------------------------------------------------------------------------
+# Searchers
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    """Pluggable search algorithm (reference: ``tune/search/searcher.py``)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[Dict] = None, error: bool = False
+    ) -> None:
+        pass
+
+
+def _split_grid(space: Dict, prefix: Tuple = ()) -> Tuple[List[Tuple[Tuple, List]], Dict]:
+    """Collect (key_path, values) grid axes; return (grids, space)."""
+    grids: List[Tuple[Tuple, List]] = []
+
+    def rec(node, path):
+        if isinstance(node, GridSearch):
+            grids.append((path, node.values))
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                rec(v, path + (k,))
+
+    rec(space, prefix)
+    return grids, space
+
+
+def _assign(config: Dict, path: Tuple, value: Any) -> None:
+    node = config
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def _resolve(space: Any, rng: random.Random, partial: Dict) -> Any:
+    if isinstance(space, dict):
+        out = {}
+        for k, v in space.items():
+            out[k] = _resolve(v, rng, partial)
+        return out
+    if isinstance(space, SampleFrom):
+        return space.fn(partial)
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, GridSearch):
+        return None  # placeholder; the generator overwrites via _assign
+    return space
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid expansion × random sampling (reference:
+    ``tune/search/basic_variant.py``). ``num_samples`` repeats the full grid;
+    pure-random spaces yield ``num_samples`` variants."""
+
+    def __init__(self, space: Dict, num_samples: int = 1, seed: Optional[int] = None):
+        super().__init__()
+        self.space = space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._generate()
+        self._next = 0
+
+    def _generate(self) -> List[Dict]:
+        grids, _ = _split_grid(self.space)
+        variants: List[Dict] = []
+        for _ in range(self.num_samples):
+            if grids:
+                for combo in itertools.product(*(vals for _, vals in grids)):
+                    cfg = _resolve(self.space, self.rng, {})
+                    for (path, _), value in zip(grids, combo):
+                        _assign(cfg, path, value)
+                    variants.append(cfg)
+            else:
+                variants.append(_resolve(self.space, self.rng, {}))
+        return variants
+
+    @property
+    def total_variants(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg

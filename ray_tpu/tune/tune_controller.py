@@ -1,0 +1,255 @@
+"""TuneController — the experiment event loop.
+
+Analog of the reference's ``python/ray/tune/execution/tune_controller.py``
+(step loop :667 driving trial actors through ``RayActorManager``
+``air/execution/_internal/actor_manager.py:23``): launch trials up to the
+concurrency/resource budget, stream their results through a collector actor,
+feed each result to the scheduler, and execute STOP/RESTART decisions.
+
+Early stop is delivered at the next ``report()``: the trial's report hook
+checks the controller's decision and raises ``_StopTrial`` inside the trial
+function — the deterministic in-runtime analog of the reference killing the
+trial actor.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, set_context
+from ray_tpu.tune.experiment import Trial, TrialStatus
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+
+
+class _StopTrial(BaseException):
+    """Raised inside a trial fn at report() when the scheduler said stop.
+    BaseException so user ``except Exception`` blocks don't swallow it."""
+
+
+class _TuneCollectorImpl:
+    """Mailbox between trial runners and the controller."""
+
+    def __init__(self):
+        self.results: List[dict] = []  # [{trial_id, iter, metrics, ckpt}]
+        self.decisions: Dict[str, str] = {}
+        self.done: Dict[str, Optional[str]] = {}
+
+    def push(self, trial_id: str, iteration: int, metrics: dict, ckpt_path: Optional[str]) -> str:
+        self.results.append(
+            {"trial_id": trial_id, "iter": iteration, "metrics": metrics, "ckpt": ckpt_path}
+        )
+        return self.decisions.get(trial_id, "CONTINUE")
+
+    def decide(self, trial_id: str, decision: str):
+        self.decisions[trial_id] = decision
+        return True
+
+    def finish(self, trial_id: str, error: Optional[str]):
+        self.done[trial_id] = error
+        return True
+
+    def clear(self, trial_id: str):
+        """Reset decision/done state before a trial relaunch (PBT)."""
+        self.decisions.pop(trial_id, None)
+        self.done.pop(trial_id, None)
+        return True
+
+    def drain(self):
+        """Return and consume queued results + finished map."""
+        out, self.results = self.results, []
+        done, self.done = self.done, {}
+        return out, done
+
+
+def _trial_main(fn: Callable, config: Dict, trial_id: str, collector, ckpt_path: Optional[str]):
+    """Runs inside a trial actor: wire the session context so both
+    ``ray_tpu.tune.report`` and ``ray_tpu.train.report`` stream here."""
+    state = {"i": 0}
+
+    def on_report(result):
+        state["i"] += 1
+        metrics = dict(result.metrics)
+        metrics.setdefault("training_iteration", state["i"])
+        cp = result.checkpoint.path if result.checkpoint else None
+        decision = ray_tpu.get(collector.push.remote(trial_id, state["i"], metrics, cp))
+        if decision == "STOP":
+            raise _StopTrial()
+
+    ctx = TrainContext(
+        world_rank=0, world_size=1, local_rank=0, local_world_size=1, node_rank=0,
+        trial_name=trial_id,
+        checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
+        report_fn=on_report,
+    )
+    set_context(ctx)
+    error: Optional[str] = None
+    stopped = False
+    try:
+        result = fn(config)
+        if isinstance(result, dict):
+            # function returned final metrics (reference supports both styles)
+            on_report(type("R", (), {"metrics": result, "checkpoint": None})())
+    except _StopTrial:
+        stopped = True
+    except BaseException as e:  # noqa: BLE001
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        set_context(None)
+        ray_tpu.get(collector.finish.remote(trial_id, error))
+    return {"stopped": stopped, "error": error}
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        trials: List[Trial],
+        *,
+        scheduler: Optional[TrialScheduler] = None,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_concurrent: Optional[int] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        searcher=None,
+    ):
+        self.trainable = trainable
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        if metric:
+            self.scheduler.set_metric(metric, mode)
+        else:
+            self.scheduler.metric = None
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent or 8
+        self.resources_per_trial = resources_per_trial or {"CPU": 1}
+        self.searcher = searcher
+        self._runners: Dict[str, Any] = {}
+        self._run_refs: Dict[str, Any] = {}
+        self._collector = None
+
+    # -- helpers -------------------------------------------------------------
+    def _launch(self, trial: Trial) -> None:
+        opts: Dict[str, Any] = {}
+        res = dict(self.resources_per_trial)
+        if "CPU" in res:
+            opts["num_cpus"] = res.pop("CPU")
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+
+        runner_cls = ray_tpu.remote(_TrialRunnerActor)
+        runner = runner_cls.options(**opts).remote()
+        ray_tpu.get(self._collector.clear.remote(trial.trial_id))
+        trial._stop_issued = False
+        ckpt = trial.restore_checkpoint
+        ref = runner.run.remote(
+            self.trainable,
+            dict(trial.config),
+            trial.trial_id,
+            self._collector,
+            ckpt.path if ckpt else None,
+        )
+        trial.status = TrialStatus.RUNNING
+        trial.restore_checkpoint = None
+        self._runners[trial.trial_id] = runner
+        self._run_refs[trial.trial_id] = ref
+
+    def _cleanup_runner(self, trial_id: str) -> None:
+        runner = self._runners.pop(trial_id, None)
+        self._run_refs.pop(trial_id, None)
+        if runner is not None:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+
+    # -- the loop ------------------------------------------------------------
+    def run(self) -> List[Trial]:
+        collector_cls = ray_tpu.remote(_TuneCollectorImpl)
+        self._collector = collector_cls.options(num_cpus=0).remote()
+        by_id = {t.trial_id: t for t in self.trials}
+        pending = list(self.trials)
+        restarting: List[Trial] = []
+
+        while True:
+            # launch up to budget
+            while (pending or restarting) and len(self._runners) < self.max_concurrent:
+                trial = restarting.pop(0) if restarting else pending.pop(0)
+                self._launch(trial)
+
+            if not self._runners and not pending and not restarting:
+                break
+
+            results, done = ray_tpu.get(self._collector.drain.remote())
+            for r in results:
+                trial = by_id[r["trial_id"]]
+                if trial.is_finished():
+                    continue
+                metrics = r["metrics"]
+                trial.last_result = metrics
+                trial.metrics_history.append(metrics)
+                if r["ckpt"]:
+                    trial.latest_checkpoint = Checkpoint(r["ckpt"])
+                if self.searcher is not None:
+                    self.searcher.on_trial_result(trial.trial_id, metrics)
+                if self.scheduler.metric is not None and self.scheduler.metric in metrics:
+                    decision = self.scheduler.on_trial_result(trial, metrics)
+                else:
+                    decision = TrialScheduler.CONTINUE
+                if decision == TrialScheduler.STOP:
+                    ray_tpu.get(self._collector.decide.remote(trial.trial_id, "STOP"))
+                    trial._stop_issued = True
+                elif decision == TrialScheduler.RESTART:
+                    # PBT exploit: stop now, respawn with mutated config +
+                    # donor checkpoint (scheduler already rewrote trial.config
+                    # and trial.restore_checkpoint).
+                    ray_tpu.get(self._collector.decide.remote(trial.trial_id, "STOP"))
+                    trial.restarts += 1
+                    trial._pbt_restart_pending = True
+
+            for trial_id, error in done.items():
+                trial = by_id[trial_id]
+                if trial_id not in self._runners:
+                    continue  # already handled
+                self._cleanup_runner(trial_id)
+                if getattr(trial, "_pbt_restart_pending", False):
+                    trial._pbt_restart_pending = False
+                    trial.status = TrialStatus.PENDING
+                    restarting.append(trial)
+                elif error:
+                    trial.status = TrialStatus.ERROR
+                    trial.error = error
+                    if self.searcher is not None:
+                        self.searcher.on_trial_complete(trial_id, error=True)
+                else:
+                    trial.status = (
+                        TrialStatus.STOPPED
+                        if getattr(trial, "_stop_issued", False)
+                        else TrialStatus.TERMINATED
+                    )
+                    if self.searcher is not None:
+                        self.searcher.on_trial_complete(trial_id, result=trial.last_result)
+                    self.scheduler.on_trial_complete(trial, trial.last_result)
+
+            if not results and not done:
+                time.sleep(0.02)
+
+        try:
+            ray_tpu.kill(self._collector)
+        except Exception:
+            pass
+        self._collector = None
+        return self.trials
+
+
+class _TrialRunnerActor:
+    """Actor wrapper so each trial gets its own mailbox + resources."""
+
+    def run(self, fn, config, trial_id, collector, ckpt_path):
+        return _trial_main(fn, config, trial_id, collector, ckpt_path)

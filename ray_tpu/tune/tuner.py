@@ -1,0 +1,167 @@
+"""Tuner / tune.run / ResultGrid.
+
+Analog of the reference's ``python/ray/tune/tuner.py`` + ``tune/tune.py`` +
+``tune/result_grid.py``. Trainables are functions (``fn(config)`` reporting
+via ``ray_tpu.tune.report``) or trainers via ``Trainer.as_trainable()``
+(mirroring ``base_trainer.py:819``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.trainer import Result
+from ray_tpu.tune.experiment import Trial, TrialStatus
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    """Reference: ``tune/tune_config.py``."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+
+
+class ResultGrid:
+    """Reference: ``tune/result_grid.py``."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self.results = [
+            Result(
+                metrics=t.last_result,
+                checkpoint=t.latest_checkpoint,
+                error=RuntimeError(t.error) if t.error else None,
+                metrics_history=t.metrics_history,
+            )
+            for t in trials
+        ]
+
+    def __len__(self):
+        return len(self.results)
+
+    def __getitem__(self, i):
+        return self.results[i]
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [r.error for r in self.results if r.error]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric or pass one)")
+        scored = [r for r in self.results if metric in r.metrics]
+        if not scored:
+            raise RuntimeError("no trial reported the metric " + metric)
+        key = lambda r: r.metrics[metric]
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics for r in self.results])
+
+
+class Tuner:
+    """Reference: ``tune/tuner.py``."""
+
+    def __init__(
+        self,
+        trainable: Callable | Any,
+        *,
+        param_space: Optional[Dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        # Trainer objects (DataParallelTrainer etc.) wrap themselves
+        # (reference: Tuner(trainer) uses trainer.as_trainable()).
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        searcher = tc.search_alg
+        if searcher is None:
+            searcher = BasicVariantGenerator(self.param_space, num_samples=tc.num_samples)
+            n_trials = searcher.total_variants
+        else:
+            n_trials = tc.num_samples
+        if searcher.metric is None:
+            searcher.metric = tc.metric
+            searcher.mode = tc.mode
+
+        trials = []
+        for _ in range(n_trials):
+            t = Trial(config={})
+            cfg = searcher.suggest(t.trial_id)
+            if cfg is None:
+                break
+            t.config = cfg
+            trials.append(t)
+
+        controller = TuneController(
+            self.trainable,
+            trials,
+            scheduler=tc.scheduler,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            resources_per_trial=self.resources_per_trial,
+            searcher=searcher if not isinstance(searcher, BasicVariantGenerator) else None,
+        )
+        controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+
+def run(
+    trainable: Callable,
+    *,
+    config: Optional[Dict] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "max",
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    max_concurrent_trials: Optional[int] = None,
+    resources_per_trial: Optional[Dict[str, float]] = None,
+) -> ResultGrid:
+    """``tune.run`` convenience wrapper (reference: ``tune/tune.py``)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            scheduler=scheduler,
+            search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+        resources_per_trial=resources_per_trial,
+    ).fit()
