@@ -1,0 +1,38 @@
+"""ray_tpu.data — lazy streaming datasets over the distributed runtime.
+
+Public surface mirrors ``ray.data``: read_* constructors, Dataset transforms,
+streaming execution, per-rank iterators for Train ingest.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import (
+    Dataset,
+    GroupedData,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Dataset",
+    "GroupedData",
+    "DataIterator",
+    "Block",
+    "BlockAccessor",
+    "range",
+    "from_items",
+    "from_pandas",
+    "from_numpy",
+    "from_arrow",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+]

@@ -1,0 +1,129 @@
+"""Blocks — the unit of data movement (Arrow tables in the object store).
+
+Analog of the reference's block model (``python/ray/data/block.py``,
+``_internal/arrow_block.py``): a Dataset is a list of object-store refs to
+Arrow tables; ``BlockAccessor`` is the typed facade over a block. Arrow
+columns convert zero-copy to numpy for the TPU ingest path (host numpy →
+``jax.device_put`` under the consumer's sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Row = Dict[str, Any]
+Batch = Dict[str, np.ndarray]
+
+
+class BlockAccessor:
+    """Reference: ``python/ray/data/block.py BlockAccessor``."""
+
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def from_items(items: List[Any]) -> Block:
+        if items and isinstance(items[0], Mapping):
+            cols: Dict[str, List] = {}
+            for it in items:
+                for k, v in it.items():
+                    cols.setdefault(k, []).append(v)
+            return pa.table(cols)
+        return pa.table({"item": list(items)})
+
+    @staticmethod
+    def from_pandas(df) -> Block:
+        return pa.Table.from_pandas(df, preserve_index=False)
+
+    @staticmethod
+    def from_numpy(data: Union[np.ndarray, Dict[str, np.ndarray]]) -> Block:
+        if isinstance(data, np.ndarray):
+            data = {"data": data}
+        cols = {}
+        for k, v in data.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                # tensor column: fixed-shape lists (reference: ArrowTensorArray)
+                flat = pa.array(v.reshape(v.shape[0], -1).tolist())
+                cols[k] = flat
+            else:
+                cols[k] = pa.array(v)
+        return pa.table(cols)
+
+    @staticmethod
+    def batch_to_block(batch: Union[Batch, "pa.Table", Any]) -> Block:
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            return BlockAccessor.from_numpy(batch)
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return BlockAccessor.from_pandas(batch)
+        except ImportError:
+            pass
+        raise TypeError(f"cannot convert {type(batch)} to a block")
+
+    # -- accessors -----------------------------------------------------------
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take(self, indices: List[int]) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Batch:
+        cols = columns or self._table.column_names
+        return {c: self._column_to_numpy(c) for c in cols}
+
+    def _column_to_numpy(self, name: str) -> np.ndarray:
+        col = self._table.column(name)
+        try:
+            return col.to_numpy(zero_copy_only=False)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            return np.asarray(col.to_pylist())
+
+    def iter_rows(self) -> Iterable[Row]:
+        for batch in self._table.to_batches():
+            for row in batch.to_pylist():
+                yield row
+
+    def select(self, columns: List[str]) -> Block:
+        return self._table.select(columns)
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b is not None and b.num_rows >= 0]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks, promote_options="default")
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self.num_rows())
+        idx = rng.choice(self.num_rows(), size=n, replace=False)
+        return self.take(list(idx))

@@ -1,0 +1,172 @@
+"""Streaming executor — pull-based block pipeline over runtime tasks.
+
+Analog of the reference's ``python/ray/data/_internal/execution/``
+(``StreamingExecutor`` ``streaming_executor.py:51``, operators under
+``operators/``, backpressure policies): the optimized plan compiles to a
+chain of generators over block refs. Each map stage keeps at most
+``max_in_flight`` tasks outstanding (backpressure: a stage only submits when
+the consumer pulls), so a Dataset never materializes fully unless an
+all-to-all barrier requires it. Map stages run as runtime TASKS (or a
+round-robin ACTOR pool for ``compute="actors"`` — the analog of
+``ActorPoolMapOperator``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data.plan import (
+    AllToAll,
+    InputData,
+    Limit,
+    LogicalOp,
+    LogicalPlan,
+    MapBlocks,
+    Read,
+    Union,
+)
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+def _run_read_task(task: Callable):
+    return task()
+
+
+def _apply_map(fn: Callable, block):
+    return fn(block)
+
+
+class _MapActorImpl:
+    """Reusable map worker (reference: ``ActorPoolMapOperator``)."""
+
+    def __init__(self, fn_ctor: Optional[Callable] = None):
+        self._state = fn_ctor() if fn_ctor is not None else None
+
+    def apply(self, fn: Callable, block):
+        if self._state is not None:
+            return fn(self._state, block)
+        return fn(block)
+
+
+def execute_streaming(
+    plan: LogicalPlan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+) -> Iterator[Any]:
+    """Yield block refs as they become available."""
+    return _compile(plan.optimized().dag, max_in_flight)
+
+
+def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
+    if isinstance(op, InputData):
+        return iter(list(op.block_refs))
+    if isinstance(op, Read):
+        read_remote = ray_tpu.remote(_run_read_task)
+
+        def gen_read() -> Iterator[Any]:
+            pending: deque = deque()
+            tasks = iter(op.read_tasks)
+            exhausted = False
+            while True:
+                while not exhausted and len(pending) < max_in_flight:
+                    t = next(tasks, None)
+                    if t is None:
+                        exhausted = True
+                        break
+                    pending.append(read_remote.remote(t))
+                if not pending:
+                    return
+                yield pending.popleft()
+
+        return gen_read()
+    if isinstance(op, MapBlocks):
+        upstream = _compile(op.inputs[0], max_in_flight)
+        if op.compute == "actors":
+            return _actor_map(op, upstream, max_in_flight)
+        map_remote = ray_tpu.remote(_apply_map).options(num_cpus=op.num_cpus)
+        cap = op.concurrency or max_in_flight
+
+        def gen_map() -> Iterator[Any]:
+            pending: deque = deque()
+            exhausted = False
+            while True:
+                while not exhausted and len(pending) < cap:
+                    ref = next(upstream, None)
+                    if ref is None:
+                        exhausted = True
+                        break
+                    pending.append(map_remote.remote(op.fn, ref))
+                if not pending:
+                    return
+                yield pending.popleft()
+
+        return gen_map()
+    if isinstance(op, AllToAll):
+        upstream = _compile(op.inputs[0], max_in_flight)
+
+        def gen_barrier() -> Iterator[Any]:
+            all_refs = list(upstream)
+            yield from op.fn(all_refs)
+
+        return gen_barrier()
+    if isinstance(op, Union):
+        streams = [_compile(i, max_in_flight) for i in op.inputs]
+
+        def gen_union() -> Iterator[Any]:
+            for s in streams:
+                yield from s
+
+        return gen_union()
+    if isinstance(op, Limit):
+        upstream = _compile(op.inputs[0], max_in_flight)
+
+        def gen_limit() -> Iterator[Any]:
+            from ray_tpu.data.block import BlockAccessor
+
+            remaining = op.n
+            for ref in upstream:
+                if remaining <= 0:
+                    return
+                block = ray_tpu.get(ref)
+                acc = BlockAccessor(block)
+                if acc.num_rows() <= remaining:
+                    remaining -= acc.num_rows()
+                    yield ray_tpu.put(block)
+                else:
+                    yield ray_tpu.put(acc.slice(0, remaining))
+                    remaining = 0
+
+        return gen_limit()
+    raise TypeError(f"unknown logical op {type(op)}")
+
+
+def _actor_map(op: MapBlocks, upstream: Iterator[Any], max_in_flight: int) -> Iterator[Any]:
+    pool_size = op.concurrency or 2
+    actor_cls = ray_tpu.remote(_MapActorImpl)
+    actors = [actor_cls.options(num_cpus=op.num_cpus).remote() for _ in range(pool_size)]
+
+    def gen() -> Iterator[Any]:
+        pending: deque = deque()
+        exhausted = False
+        i = 0
+        try:
+            while True:
+                while not exhausted and len(pending) < pool_size * 2:
+                    ref = next(upstream, None)
+                    if ref is None:
+                        exhausted = True
+                        break
+                    pending.append(actors[i % pool_size].apply.remote(op.fn, ref))
+                    i += 1
+                if not pending:
+                    return
+                yield pending.popleft()
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    return gen()

@@ -1,0 +1,56 @@
+"""DataIterator — per-rank Train ingest.
+
+Analog of the reference's ``python/ray/data/iterator.py`` (``DataIterator``,
+``iter_torch_batches``): the TPU variant is ``iter_jax_batches`` — host numpy
+batches placed on device under a caller-provided sharding (the idiomatic
+host→HBM feed: no framework tensors in the object store, placement decided by
+the consumer's mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class DataIterator:
+    def __init__(self, dataset):
+        self._ds = dataset
+
+    def iter_batches(self, **kw) -> Iterator[Dict[str, np.ndarray]]:
+        return self._ds.iter_batches(**kw)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 1024,
+        sharding: Optional[Any] = None,
+        dtypes: Optional[Dict[str, Any]] = None,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None,
+    ) -> Iterator[Any]:
+        """Numpy batches → device arrays (optionally under ``sharding``)."""
+        import jax
+        import jax.numpy as jnp
+
+        for batch in self._ds.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            if collate_fn is not None:
+                yield collate_fn(batch)
+                continue
+            out = {}
+            for k, v in batch.items():
+                arr = jnp.asarray(v, dtype=dtypes.get(k) if dtypes else None)
+                if sharding is not None:
+                    arr = jax.device_put(arr, sharding)
+                out[k] = arr
+            yield out
+
+    def materialize(self):
+        return self._ds.materialize()
+
+    def stats(self) -> str:
+        return f"DataIterator over {self._ds!r}"

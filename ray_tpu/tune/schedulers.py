@@ -24,6 +24,9 @@ class TrialScheduler:
     STOP = "STOP"
     RESTART = "RESTART"  # PBT exploit: restart with mutated config+checkpoint
 
+    metric: Optional[str] = None
+    mode: str = "max"
+
     def set_metric(self, metric: str, mode: str) -> None:
         self.metric = metric
         self.mode = mode
@@ -75,21 +78,27 @@ class ASHAScheduler(TrialScheduler):
             self.milestones.append(int(t))
             t *= reduction_factor
         self._rung_scores: Dict[int, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = defaultdict(int)  # next rung index
 
     def on_trial_result(self, trial: "Trial", result: Dict) -> str:
         t = int(result.get(self.time_attr, 0))
         if t >= self.max_t:
             return self.STOP
         decision = self.CONTINUE
-        for milestone in self.milestones:
-            if t == milestone:
-                scores = self._rung_scores[milestone]
-                score = self._score(result)
-                scores.append(score)
-                k = max(1, int(len(scores) / self.rf))
-                cutoff = sorted(scores, reverse=True)[k - 1]
-                if score < cutoff:
-                    decision = self.STOP
+        score = self._score(result)
+        # Enter every rung this trial has newly crossed (t >= milestone; a
+        # trial reporting a custom time_attr need not hit milestones exactly).
+        i = self._trial_rung[trial.trial_id]
+        while i < len(self.milestones) and t >= self.milestones[i]:
+            milestone = self.milestones[i]
+            scores = self._rung_scores[milestone]
+            scores.append(score)
+            k = max(1, int(len(scores) / self.rf))
+            cutoff = sorted(scores, reverse=True)[k - 1]
+            if score < cutoff:
+                decision = self.STOP
+            i += 1
+        self._trial_rung[trial.trial_id] = i
         return decision
 
 
@@ -156,6 +165,8 @@ class PopulationBasedTraining(TrialScheduler):
         self.time_attr = time_attr
         self.metric = metric
         self.mode = mode
+        if not 0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
         self.interval = perturbation_interval
         self.mutations = hyperparam_mutations or {}
         self.quantile = quantile_fraction

@@ -173,10 +173,23 @@ def _assign(config: Dict, path: Tuple, value: Any) -> None:
 
 
 def _resolve(space: Any, rng: random.Random, partial: Dict) -> Any:
+    """Resolve a (sub)space. Within each dict level, plain values and Domains
+    resolve first and SampleFrom callbacks run last against the
+    partially-built config, so ``sample_from(lambda c: c["a"] * 2)`` sees
+    sibling ``a`` (including grid-chosen values pre-seeded by the
+    generator)."""
     if isinstance(space, dict):
-        out = {}
+        out: Dict = dict(partial) if partial else {}
+        deferred = []
         for k, v in space.items():
-            out[k] = _resolve(v, rng, partial)
+            if k in out:
+                continue  # pre-seeded by a grid assignment
+            if isinstance(v, SampleFrom):
+                deferred.append((k, v))
+            else:
+                out[k] = _resolve(v, rng, {})
+        for k, v in deferred:
+            out[k] = v.fn(out)
         return out
     if isinstance(space, SampleFrom):
         return space.fn(partial)
@@ -206,7 +219,12 @@ class BasicVariantGenerator(Searcher):
         for _ in range(self.num_samples):
             if grids:
                 for combo in itertools.product(*(vals for _, vals in grids)):
-                    cfg = _resolve(self.space, self.rng, {})
+                    seed_cfg: Dict = {}
+                    for (path, _), value in zip(grids, combo):
+                        _assign(seed_cfg, path, value)
+                    # top-level grid keys pre-seed resolution so sample_from
+                    # callbacks can read them; nested grids are assigned after
+                    cfg = _resolve(self.space, self.rng, seed_cfg)
                     for (path, _), value in zip(grids, combo):
                         _assign(cfg, path, value)
                     variants.append(cfg)

@@ -14,13 +14,12 @@ trial actor.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.session import TrainContext, set_context
+from ray_tpu.train.session import TrainContext, TrainingResult, set_context
 from ray_tpu.tune.experiment import Trial, TrialStatus
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
 
@@ -48,8 +47,8 @@ class _TuneCollectorImpl:
         self.decisions[trial_id] = decision
         return True
 
-    def finish(self, trial_id: str, error: Optional[str]):
-        self.done[trial_id] = error
+    def finish(self, trial_id: str, error: Optional[str], stopped: bool = False):
+        self.done[trial_id] = {"error": error, "stopped": stopped}
         return True
 
     def clear(self, trial_id: str):
@@ -92,14 +91,14 @@ def _trial_main(fn: Callable, config: Dict, trial_id: str, collector, ckpt_path:
         result = fn(config)
         if isinstance(result, dict):
             # function returned final metrics (reference supports both styles)
-            on_report(type("R", (), {"metrics": result, "checkpoint": None})())
+            on_report(TrainingResult(metrics=result))
     except _StopTrial:
         stopped = True
     except BaseException as e:  # noqa: BLE001
         error = f"{type(e).__name__}: {e}"
     finally:
         set_context(None)
-        ray_tpu.get(collector.finish.remote(trial_id, error))
+        ray_tpu.get(collector.finish.remote(trial_id, error, stopped))
     return {"stopped": stopped, "error": error}
 
 
@@ -119,10 +118,11 @@ class TuneController:
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or FIFOScheduler()
-        if metric:
+        # A scheduler constructed with its own metric/mode wins; otherwise it
+        # inherits the experiment's (reference: Tune errors on double-spec —
+        # here scheduler-local settings take precedence).
+        if getattr(self.scheduler, "metric", None) is None and metric:
             self.scheduler.set_metric(metric, mode)
-        else:
-            self.scheduler.metric = None
         self.metric = metric
         self.mode = mode
         self.max_concurrent = max_concurrent or 8
@@ -213,10 +213,11 @@ class TuneController:
                     trial.restarts += 1
                     trial._pbt_restart_pending = True
 
-            for trial_id, error in done.items():
+            for trial_id, fin in done.items():
                 trial = by_id[trial_id]
                 if trial_id not in self._runners:
                     continue  # already handled
+                error = fin["error"]
                 self._cleanup_runner(trial_id)
                 if getattr(trial, "_pbt_restart_pending", False):
                     trial._pbt_restart_pending = False
@@ -229,9 +230,7 @@ class TuneController:
                         self.searcher.on_trial_complete(trial_id, error=True)
                 else:
                     trial.status = (
-                        TrialStatus.STOPPED
-                        if getattr(trial, "_stop_issued", False)
-                        else TrialStatus.TERMINATED
+                        TrialStatus.STOPPED if fin["stopped"] else TrialStatus.TERMINATED
                     )
                     if self.searcher is not None:
                         self.searcher.on_trial_complete(trial_id, result=trial.last_result)

@@ -176,3 +176,42 @@ class TestTunerE2E:
         ).fit()
         assert len(grid) == 3
         assert grid.get_best_result().metrics["loss"] == 0.25
+
+
+class TestReviewRegressions:
+    def test_scheduler_own_metric_respected(self):
+        from ray_tpu.tune.tune_controller import TuneController
+
+        sched = ASHAScheduler(metric="loss", mode="min", max_t=8)
+        c = TuneController(lambda cfg: None, [], scheduler=sched)
+        assert sched.metric == "loss" and sched.mode == "min"
+
+    def test_asha_uneven_time_attr(self):
+        from ray_tpu.tune.experiment import Trial
+
+        sched = ASHAScheduler(metric="s", mode="max", time_attr="step",
+                              max_t=100, grace_period=2, reduction_factor=2)
+        good, bad = Trial({}), Trial({})
+        # reports at step 5 (crosses milestones 2 and 4 at once)
+        assert sched.on_trial_result(good, {"step": 5, "s": 10.0}) == "CONTINUE"
+        assert sched.on_trial_result(bad, {"step": 5, "s": 0.1}) == "STOP"
+
+    def test_sample_from_sees_siblings(self):
+        gen = BasicVariantGenerator(
+            {"a": tune.choice([3]), "b": tune.sample_from(lambda c: c["a"] * 2)},
+            num_samples=2, seed=0,
+        )
+        cfg = gen.suggest("0")
+        assert cfg == {"a": 3, "b": 6}
+
+    def test_sample_from_sees_grid_values(self):
+        gen = BasicVariantGenerator(
+            {"a": tune.grid_search([1, 5]), "b": tune.sample_from(lambda c: c["a"] + 1)},
+            num_samples=1,
+        )
+        cfgs = [gen.suggest(str(i)) for i in range(2)]
+        assert sorted((c["a"], c["b"]) for c in cfgs) == [(1, 2), (5, 6)]
+
+    def test_pbt_rejects_bad_quantile(self):
+        with pytest.raises(ValueError):
+            PopulationBasedTraining(metric="s", quantile_fraction=0.7)
