@@ -1,0 +1,33 @@
+"""ray_tpu.serve — model serving over the distributed runtime.
+
+Public surface mirrors ``ray.serve``: @deployment/bind/run, DeploymentHandle,
+HTTP ingress, autoscaling, batching.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "shutdown",
+    "status",
+    "delete",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "AutoscalingConfig",
+    "DeploymentConfig",
+    "batch",
+]

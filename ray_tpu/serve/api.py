@@ -1,0 +1,110 @@
+"""serve.run / serve.shutdown / handles — public control API.
+
+Analog of the reference's ``python/ray/serve/api.py`` (``serve.run`` :543):
+walk the bound app graph dependencies-first, deploy each node (bound-handle
+args replaced with DeploymentHandles — the composed-app pattern), wait for
+replicas, return the ingress handle. The HTTP proxy starts lazily on the
+first run with a route_prefix.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, get_or_create_controller
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+_proxy = None  # module-level HTTP proxy singleton
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    blocking: bool = False,
+    _start_proxy: bool = False,
+    http_port: int = 8000,
+) -> DeploymentHandle:
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = get_or_create_controller()
+
+    nodes = app.walk()
+    handles: Dict[int, DeploymentHandle] = {}
+    for node in nodes:
+        dep = node.deployment
+        init_args = tuple(
+            handles[id(a)] if isinstance(a, Application) else a for a in node.init_args
+        )
+        init_kwargs = {
+            k: handles[id(v)] if isinstance(v, Application) else v
+            for k, v in node.init_kwargs.items()
+        }
+        prefix = dep.route_prefix
+        if node is nodes[-1] and prefix is None:
+            prefix = route_prefix  # ingress gets the app prefix
+        ray_tpu.get(
+            controller.deploy.remote(
+                dep.name, dep.func_or_class, init_args, init_kwargs, dep.config, prefix
+            )
+        )
+        handles[id(node)] = DeploymentHandle(dep.name, controller)
+
+    ingress = handles[id(nodes[-1])]
+    _wait_ready(controller, [n.deployment.name for n in nodes])
+
+    if _start_proxy:
+        global _proxy
+        if _proxy is None:
+            from ray_tpu.serve.proxy import HttpProxy
+
+            _proxy = HttpProxy(controller, port=http_port)
+            _proxy.start()
+    return ingress
+
+
+def _wait_ready(controller, names, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        info = ray_tpu.get(controller.list_deployments.remote())
+        if all(
+            n in info and info[n]["num_replicas"] >= max(1, info[n]["target_replicas"])
+            for n in names
+        ):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"deployments {names} not ready within {timeout_s}s")
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def status() -> Dict[str, dict]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(deployment_name: str) -> None:
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(deployment_name))
+
+
+def shutdown() -> None:
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,46 @@
+"""Serve configuration schemas.
+
+Analog of the reference's ``python/ray/serve/config.py`` +
+``serve/schema.py`` (pydantic there; plain dataclasses here — same fields,
+validated in __post_init__).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: ``serve/config.py AutoscalingConfig`` — replicas scale on
+    ongoing-requests-per-replica (``autoscaling_policy.py``)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError("need 0 <= min_replicas <= max_replicas")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    user_config: Optional[Dict] = None
+    health_check_period_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    def __post_init__(self):
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_ongoing_requests <= 0:
+            raise ValueError("max_ongoing_requests must be > 0")

@@ -1,0 +1,222 @@
+"""ServeController — the reconciler control plane.
+
+Analog of the reference's ``python/ray/serve/_private/controller.py:85``
+(``ServeController``) + ``deployment_state.py`` (target-vs-actual reconcile
+:2807) + ``long_poll.py`` (config push): a singleton actor owning desired
+state; a background reconcile thread starts/stops replica actors to match;
+handles learn replica sets via versioned long-poll snapshots. The request
+path NEVER touches the controller (reference's data/control split).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclass
+class _DeploymentTarget:
+    name: str
+    callable_or_class: Any
+    init_args: tuple
+    init_kwargs: dict
+    config: DeploymentConfig
+    route_prefix: Optional[str] = None
+    target_replicas: int = 1
+    version: int = 0  # bumped on redeploy; stale-version replicas are culled
+
+
+class ServeControllerActor:
+    def __init__(self):
+        self._targets: Dict[str, _DeploymentTarget] = {}
+        # name -> [(version, actor handle)]
+        self._replicas: Dict[str, List[Any]] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        self._running = True
+        self._metrics: Dict[str, float] = {}  # deployment -> reported ongoing
+        self._last_downscale: Dict[str, float] = {}
+        self._reconcile_thread = threading.Thread(target=self._loop, daemon=True)
+        self._reconcile_thread.start()
+
+    # -- control API ---------------------------------------------------------
+    def deploy(
+        self,
+        name: str,
+        callable_or_class: Any,
+        init_args: tuple,
+        init_kwargs: dict,
+        config: DeploymentConfig,
+        route_prefix: Optional[str],
+    ) -> bool:
+        with self._lock:
+            target = _DeploymentTarget(
+                name, callable_or_class, init_args, init_kwargs, config, route_prefix
+            )
+            asc = config.autoscaling_config
+            target.target_replicas = (
+                max(asc.min_replicas, 1) if asc else config.num_replicas
+            )
+            prev = self._targets.get(name)
+            target.version = prev.version + 1 if prev is not None else 0
+            self._targets[name] = target
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            self._targets.pop(name, None)
+        self._reconcile_once()
+        return True
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                n: {
+                    "target_replicas": t.target_replicas,
+                    "num_replicas": len(
+                        [r for v, r in self._replicas.get(n, []) if v == t.version]
+                    ),
+                    "route_prefix": t.route_prefix,
+                    "max_ongoing_requests": t.config.max_ongoing_requests,
+                }
+                for n, t in self._targets.items()
+            }
+
+    def shutdown(self) -> bool:
+        self._running = False
+        with self._lock:
+            self._targets.clear()
+        self._reconcile_once()
+        return True
+
+    # -- long poll (reference: long_poll.py LongPollHost) --------------------
+    def get_snapshot(self, known_version: int = -1, timeout_s: float = 0.0):
+        """Routing table snapshot; blocks up to timeout_s for a new version."""
+        deadline = time.monotonic() + timeout_s
+        while self._version == known_version and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with self._lock:
+            table = {
+                name: {
+                    "replicas": [
+                        r for v, r in self._replicas.get(name, []) if v == t.version
+                    ],
+                    "max_ongoing_requests": t.config.max_ongoing_requests,
+                    "route_prefix": t.route_prefix,
+                }
+                for name, t in self._targets.items()
+            }
+            return self._version, table
+
+    # -- metrics / autoscaling ----------------------------------------------
+    def record_autoscaling_metrics(self, deployment: str, ongoing: float) -> bool:
+        self._metrics[deployment] = ongoing
+        return True
+
+    # -- reconcile loop ------------------------------------------------------
+    def _loop(self):
+        while self._running:
+            try:
+                self._autoscale()
+                self._reconcile_once()
+            except Exception:
+                pass
+            time.sleep(0.05)
+
+    def _autoscale(self):
+        with self._lock:
+            targets = list(self._targets.values())
+        for t in targets:
+            asc = t.config.autoscaling_config
+            if asc is None:
+                continue
+            ongoing = self._metrics.get(t.name, 0.0)
+            desired = math.ceil(ongoing / asc.target_ongoing_requests) if ongoing else asc.min_replicas
+            desired = max(asc.min_replicas, min(asc.max_replicas, desired))
+            now = time.monotonic()
+            if desired < t.target_replicas:
+                # hold downscale for the delay window
+                last = self._last_downscale.setdefault(t.name, now)
+                if now - last < asc.downscale_delay_s:
+                    continue
+                self._last_downscale[t.name] = now
+            else:
+                self._last_downscale[t.name] = now
+            if desired != t.target_replicas:
+                with self._lock:
+                    t.target_replicas = desired
+
+    def _reconcile_once(self):
+        with self._lock:
+            targets = dict(self._targets)
+        changed = False
+        # scale up/down existing deployments
+        for name, t in targets.items():
+            current = self._replicas.setdefault(name, [])
+            # cull replicas from an older deploy version (redeploy)
+            stale = [(v, r) for v, r in current if v != t.version]
+            if stale:
+                for _, r in stale:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                current[:] = [(v, r) for v, r in current if v == t.version]
+                changed = True
+            while len(current) < t.target_replicas:
+                opts = dict(t.config.ray_actor_options)
+                actor_opts: Dict[str, Any] = {}
+                if "num_cpus" in opts:
+                    actor_opts["num_cpus"] = opts.pop("num_cpus")
+                if "num_tpus" in opts:
+                    actor_opts["num_tpus"] = opts.pop("num_tpus")
+                if "resources" in opts:
+                    actor_opts["resources"] = opts.pop("resources")
+                replica_cls = ray_tpu.remote(ReplicaActor)
+                replica = replica_cls.options(**actor_opts).remote(
+                    name,
+                    t.callable_or_class,
+                    t.init_args,
+                    t.init_kwargs,
+                    t.config.user_config,
+                )
+                current.append((t.version, replica))
+                changed = True
+            while len(current) > t.target_replicas:
+                _, victim = current.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+                changed = True
+        # drop deleted deployments
+        for name in list(self._replicas):
+            if name not in targets:
+                for _, r in self._replicas.pop(name):
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                changed = True
+        if changed:
+            with self._lock:
+                self._version += 1
+
+
+def get_or_create_controller():
+    """Singleton via named actor (reference: serve's detached controller)."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        cls = ray_tpu.remote(ServeControllerActor)
+        return cls.options(name=CONTROLLER_NAME, num_cpus=0).remote()

@@ -1,0 +1,184 @@
+"""DeploymentHandle + Router — the request data plane.
+
+Analog of the reference's ``python/ray/serve/handle.py`` (DeploymentHandle),
+``_private/router.py`` and
+``_private/replica_scheduler/pow_2_scheduler.py:49``: the handle pulls the
+replica set from the controller via long-poll snapshots, then routes each
+call with power-of-two-choices over client-tracked ongoing counts, respecting
+``max_ongoing_requests`` (queueing locally when all replicas are saturated,
+as the reference does). The controller is not on this path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like response (reference: ``serve/handle.py
+    DeploymentResponse``)."""
+
+    def __init__(self, ref, router: "Router", replica_key: str):
+        self._ref = ref
+        self._router = router
+        self._replica_key = replica_key
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._router._dec(self._replica_key)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentResponseGenerator:
+    def __init__(self, gen, router: "Router", replica_key: str):
+        self._gen = gen
+        self._router = router
+        self._replica_key = replica_key
+        self._done = False
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router._dec(self._replica_key)
+
+
+class Router:
+    """Pow-2-choices with client-side ongoing tracking."""
+
+    SNAPSHOT_MAX_AGE_S = 1.0
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._version = -1
+        self._replicas: List[Any] = []
+        self._max_ongoing = 100
+        self._ongoing: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._refresh(block=True)
+
+    # -- replica set maintenance --------------------------------------------
+    def _refresh(self, block: bool = False) -> None:
+        now = time.monotonic()
+        if not block and now - self._last_refresh < self.SNAPSHOT_MAX_AGE_S:
+            return
+        deadline = time.monotonic() + 10.0
+        while True:
+            version, table = ray_tpu.get(
+                self._controller.get_snapshot.remote(self._version, 0.0)
+            )
+            entry = table.get(self._name)
+            if entry and entry["replicas"]:
+                with self._lock:
+                    self._version = version
+                    self._replicas = entry["replicas"]
+                    self._max_ongoing = entry["max_ongoing_requests"]
+                self._last_refresh = now
+                return
+            if not block or time.monotonic() > deadline:
+                self._last_refresh = now
+                return
+            time.sleep(0.02)
+
+    def _key(self, replica) -> str:
+        return replica.actor_id.hex()
+
+    def _dec(self, key: str) -> None:
+        with self._lock:
+            if key in self._ongoing:
+                self._ongoing[key] = max(0, self._ongoing[key] - 1)
+
+    def _pick(self):
+        """Pow-2: sample two replicas, choose the lower client-side queue.
+        Block (with periodic refresh) while all replicas are saturated."""
+        deadline = time.monotonic() + 60.0
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                if len(replicas) == 1:
+                    cands = [replicas[0]]
+                else:
+                    cands = random.sample(replicas, 2)
+                cands.sort(key=lambda r: self._ongoing.get(self._key(r), 0))
+                best = cands[0]
+                key = self._key(best)
+                with self._lock:
+                    if self._ongoing.get(key, 0) < self._max_ongoing:
+                        self._ongoing[key] = self._ongoing.get(key, 0) + 1
+                        return best, key
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no capacity on deployment {self._name}")
+            time.sleep(0.002)
+
+    # -- metrics push (feeds autoscaling) ------------------------------------
+    def total_ongoing(self) -> int:
+        with self._lock:
+            return sum(self._ongoing.values())
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None, method_name: str = "__call__"):
+        from ray_tpu.serve.controller import get_or_create_controller
+
+        self._name = deployment_name
+        self._controller = controller or get_or_create_controller()
+        self._method = method_name
+        self._router = Router(self._controller, deployment_name)
+        self._stream = False
+        self._metrics_thread = threading.Thread(target=self._push_metrics, daemon=True)
+        self._metrics_thread.start()
+
+    def options(self, *, method_name: Optional[str] = None, stream: bool = False) -> "DeploymentHandle":
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h._name = self._name
+        h._controller = self._controller
+        h._method = method_name or self._method
+        h._router = self._router
+        h._stream = stream
+        h._metrics_thread = self._metrics_thread
+        return h
+
+    def remote(self, *args, **kwargs):
+        replica, key = self._router._pick()
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(self._method, *args, **kwargs)
+            return DeploymentResponseGenerator(gen, self._router, key)
+        ref = replica.handle_request.remote(self._method, *args, **kwargs)
+        return DeploymentResponse(ref, self._router, key)
+
+    def _push_metrics(self):
+        """Reference: ``replica.py:214 _push_autoscaling_metrics`` (pushed
+        from the data plane on a timer)."""
+        while True:
+            time.sleep(0.2)
+            try:
+                self._controller.record_autoscaling_metrics.remote(
+                    self._name, float(self._router.total_ongoing())
+                )
+            except Exception:
+                return

@@ -1,0 +1,141 @@
+"""HTTP proxy — the ingress data plane.
+
+Analog of the reference's ``python/ray/serve/_private/proxy.py`` (uvicorn +
+starlette there; aiohttp here — what the image ships). Routes by longest
+matching ``route_prefix`` from the controller's long-poll snapshot, forwards
+to a DeploymentHandle, supports JSON bodies and streaming (chunked) responses
+from generator deployments. Runs its own event loop in a daemon thread —
+the in-runtime analog of the reference's proxy actor on each ingress node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HttpProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self._controller = controller
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, str] = {}  # prefix -> deployment name
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._runner = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _serve_forever(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._runner = runner
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    # -- routing -------------------------------------------------------------
+    def _refresh_routes(self) -> None:
+        _, table = ray_tpu.get(self._controller.get_snapshot.remote(-2, 0.0))
+        routes = {}
+        for name, entry in table.items():
+            if entry.get("route_prefix"):
+                routes[entry["route_prefix"]] = name
+        self._routes = routes
+
+    def _match(self, path: str) -> Optional[str]:
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        self._refresh_routes()
+        name = self._match(request.path)
+        if name is None:
+            return web.Response(status=404, text=f"no route for {request.path}")
+        if name not in self._handles:
+            self._handles[name] = DeploymentHandle(name, self._controller)
+        handle = self._handles[name]
+
+        if request.can_read_body:
+            raw = await request.read()
+            try:
+                payload = json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                payload = raw.decode()
+        else:
+            payload = dict(request.query)
+
+        loop = asyncio.get_event_loop()
+        stream = request.headers.get("X-Serve-Stream") == "1"
+        if stream:
+            gen = handle.options(stream=True).remote(payload)
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "text/plain"
+            await resp.prepare(request)
+            it = iter(gen)
+            while True:
+                item = await loop.run_in_executor(None, lambda: next(it, _SENTINEL))
+                if item is _SENTINEL:
+                    break
+                await resp.write((json.dumps(_jsonable(item)) + "\n").encode())
+            await resp.write_eof()
+            return resp
+
+        response = handle.remote(payload)
+        result = await loop.run_in_executor(None, response.result)
+        return web.json_response(_jsonable(result))
+
+
+_SENTINEL = object()
+
+
+def _jsonable(x: Any):
+    import numpy as np
+
+    if isinstance(x, (np.generic,)):
+        return x.item()
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    return x

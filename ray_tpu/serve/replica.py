@@ -1,0 +1,108 @@
+"""Replica actor — hosts one copy of a deployment's callable.
+
+Analog of the reference's ``python/ray/serve/_private/replica.py`` (1,165
+lines): wraps the user's class/function, counts ongoing requests (the router's
+pow-2 signal), applies ``user_config`` via ``reconfigure``, exposes a health
+check, and supports sync functions, async coroutines, and (async) generators
+for streaming responses.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class ReplicaActor:
+    def __init__(
+        self,
+        deployment_name: str,
+        serialized_callable: Callable,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Optional[Dict] = None,
+    ):
+        self.deployment_name = deployment_name
+        self._is_function = not inspect.isclass(serialized_callable)
+        if self._is_function:
+            self._callable = serialized_callable
+        else:
+            self._callable = serialized_callable(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- control plane -------------------------------------------------------
+    def reconfigure(self, user_config: Dict) -> bool:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        if not self._is_function and hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+    def get_metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {"ongoing": float(self._ongoing), "total": float(self._total)}
+
+    # -- data plane ----------------------------------------------------------
+    def handle_request(self, method_name: str, *args, **kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._resolve_method(method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            if inspect.isgenerator(result):
+                # materialize sync generators; streaming goes through
+                # handle_request_streaming
+                return list(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method_name: str, *args, **kwargs):
+        """Generator method: yields items (streamed via ObjectRefGenerator)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._resolve_method(method_name)
+            result = target(*args, **kwargs)
+            if inspect.isasyncgen(result):
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(result.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    loop.close()
+            elif inspect.isgenerator(result):
+                yield from result
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def _resolve_method(self, method_name: str) -> Callable:
+        if self._is_function:
+            return self._callable
+        if method_name == "__call__":
+            return self._callable
+        return getattr(self._callable, method_name)

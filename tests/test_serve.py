@@ -1,0 +1,227 @@
+"""Serve tests, modeled on the reference's ``python/ray/serve/tests/``:
+deploy/call/scale/delete lifecycle, composition, routing, autoscaling,
+batching, streaming, HTTP ingress.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(ray_start_regular):
+    yield serve
+    serve.shutdown()
+
+
+class TestDeployLifecycle:
+    def test_function_deployment(self, serve_instance):
+        @serve.deployment
+        def square(x):
+            return x["v"] * x["v"]
+
+        h = serve.run(square.bind())
+        assert h.remote({"v": 7}).result() == 49
+
+    def test_class_deployment_with_init_args(self, serve_instance):
+        @serve.deployment
+        class Adder:
+            def __init__(self, base):
+                self.base = base
+
+            def __call__(self, x):
+                return self.base + x["v"]
+
+            def sub(self, x):
+                return x["v"] - self.base
+
+        h = serve.run(Adder.bind(100))
+        assert h.remote({"v": 5}).result() == 105
+        assert h.options(method_name="sub").remote({"v": 5}).result() == -95
+
+    def test_num_replicas_and_scale(self, serve_instance):
+        @serve.deployment(num_replicas=3)
+        def f(x):
+            return 1
+
+        serve.run(f.bind())
+        info = serve.status()
+        assert info["f"]["num_replicas"] == 3
+        serve.delete("f")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "f" in serve.status():
+            time.sleep(0.05)
+        assert "f" not in serve.status()
+
+    def test_redeploy_updates(self, serve_instance):
+        @serve.deployment
+        def g(x):
+            return "v1"
+
+        h = serve.run(g.bind())
+        assert h.remote({}).result() == "v1"
+
+        @serve.deployment(name="g")
+        def g2(x):
+            return "v2"
+
+        h2 = serve.run(g2.bind())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if h2.remote({}).result() == "v2":
+                break
+            time.sleep(0.1)
+        assert h2.remote({}).result() == "v2"
+
+    def test_composition(self, serve_instance):
+        @serve.deployment
+        class Preprocess:
+            def __call__(self, x):
+                return x["v"] * 2
+
+        @serve.deployment
+        class Ingress:
+            def __init__(self, pre):
+                self.pre = pre
+
+            def __call__(self, x):
+                doubled = self.pre.remote(x).result()
+                return doubled + 1
+
+        h = serve.run(Ingress.bind(Preprocess.bind()))
+        assert h.remote({"v": 10}).result() == 21
+
+    def test_user_config_reconfigure(self, serve_instance):
+        @serve.deployment(user_config={"threshold": 5})
+        class Thresh:
+            def __init__(self):
+                self.threshold = None
+
+            def reconfigure(self, cfg):
+                self.threshold = cfg["threshold"]
+
+            def __call__(self, x):
+                return x["v"] > self.threshold
+
+        h = serve.run(Thresh.bind())
+        assert h.remote({"v": 10}).result() is True
+        assert h.remote({"v": 3}).result() is False
+
+
+class TestRoutingAndScaling:
+    def test_pow2_spreads_load(self, serve_instance):
+        import os
+        import threading
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+        class Who:
+            def __init__(self):
+                self.ident = id(self)
+
+            def __call__(self, x):
+                time.sleep(0.05)
+                return self.ident
+
+        h = serve.run(Who.bind())
+        results = []
+        threads = [
+            # concurrent callers so pow-2 sees real queue depth
+            __import__("threading").Thread(
+                target=lambda: results.append(h.remote({}).result())
+            )
+            for _ in range(16)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(set(results)) == 2, "both replicas should have served"
+
+    def test_autoscaling_up(self, serve_instance):
+        @serve.deployment(
+            num_replicas="auto",
+            autoscaling_config={
+                "min_replicas": 1,
+                "max_replicas": 4,
+                "target_ongoing_requests": 1.0,
+            },
+            max_ongoing_requests=2,
+        )
+        def slow(x):
+            time.sleep(0.4)
+            return 1
+
+        h = serve.run(slow.bind())
+        assert serve.status()["slow"]["num_replicas"] == 1
+        import threading
+
+        threads = [
+            threading.Thread(target=lambda: h.remote({}).result()) for _ in range(8)
+        ]
+        [t.start() for t in threads]
+        deadline = time.monotonic() + 10
+        scaled = False
+        while time.monotonic() < deadline:
+            if serve.status()["slow"]["num_replicas"] >= 2:
+                scaled = True
+                break
+            time.sleep(0.05)
+        [t.join() for t in threads]
+        assert scaled, f"autoscaler never scaled up: {serve.status()}"
+
+
+class TestBatchingAndStreaming:
+    def test_batch_decorator(self, serve_instance):
+        seen_sizes = []
+
+        @serve.deployment(max_ongoing_requests=64)
+        class Model:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+            def handle(self, xs):
+                seen_sizes.append(len(xs))
+                return [x * 10 for x in xs]
+
+            def __call__(self, x):
+                return self.handle(x["v"])
+
+        h = serve.run(Model.bind())
+        import threading
+
+        results = {}
+
+        def call(i):
+            results[i] = h.remote({"v": i}).result()
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert results == {i: i * 10 for i in range(8)}
+
+    def test_streaming_response(self, serve_instance):
+        @serve.deployment
+        def streamer(x):
+            for i in range(x["n"]):
+                yield {"chunk": i}
+
+        h = serve.run(streamer.bind())
+        chunks = list(h.options(stream=True).remote({"n": 4}))
+        assert chunks == [{"chunk": i} for i in range(4)]
+
+
+class TestHttpProxy:
+    def test_http_roundtrip_and_404(self, serve_instance):
+        import httpx
+
+        @serve.deployment
+        def model(payload):
+            return {"doubled": payload["v"] * 2}
+
+        serve.run(model.bind(), route_prefix="/model", _start_proxy=True, http_port=18431)
+        r = httpx.post("http://127.0.0.1:18431/model", json={"v": 21}, timeout=10)
+        assert r.status_code == 200
+        assert r.json() == {"doubled": 42}
+        r = httpx.get("http://127.0.0.1:18431/nope", timeout=10)
+        assert r.status_code == 404
