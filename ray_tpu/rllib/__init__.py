@@ -1,0 +1,24 @@
+"""ray_tpu.rllib — reinforcement learning on the new-stack shape.
+
+Public surface mirrors the reference's new API stack (SURVEY §2.3: RLModule /
+Learner / LearnerGroup / EnvRunner; old Policy/RolloutWorker stack explicitly
+not ported — SURVEY §7 "do NOT port").
+"""
+
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec, spec_for_env
+
+__all__ = [
+    "RLModule",
+    "RLModuleSpec",
+    "spec_for_env",
+    "SingleAgentEnvRunner",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "compute_gae",
+]

@@ -1,0 +1,133 @@
+"""EnvRunner — vectorized environment sampling actors.
+
+Analog of the reference's ``rllib/env/single_agent_env_runner.py:101
+sample``: each runner holds a vectorized gymnasium env + a local copy of the
+module params, steps envs with jitted forward passes, and returns columnar
+sample batches (numpy — they cross the object store to the learners).
+Episode returns are tracked per sub-env for metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec, spec_for_env
+
+
+class SingleAgentEnvRunner:
+    def __init__(
+        self,
+        env_creator: Callable[[], Any],
+        *,
+        num_envs: int = 1,
+        seed: int = 0,
+        spec: Optional[RLModuleSpec] = None,
+    ):
+        import gymnasium as gym
+
+        self._envs = gym.vector.SyncVectorEnv(
+            [self._thunk(env_creator, seed + i) for i in range(num_envs)]
+        )
+        self.num_envs = num_envs
+        probe = env_creator()
+        self.spec = spec or spec_for_env(probe)
+        probe.close()
+        self.module = RLModule(self.spec)
+        self._params = self.module.init_params(jax.random.key(seed))
+        self._key = jax.random.key(seed + 10_000)
+        self._sample_fn = jax.jit(self.module.sample_action)
+        self._obs, _ = self._envs.reset(seed=seed)
+        self._ep_returns = np.zeros(num_envs)
+        self._ep_lens = np.zeros(num_envs, dtype=np.int64)
+        self._completed: List[float] = []
+        self._completed_lens: List[int] = []
+
+    @staticmethod
+    def _thunk(creator, seed):
+        def make():
+            env = creator()
+            env.reset(seed=seed)
+            return env
+
+        return make
+
+    # -- weights sync (reference: WorkerSet weight broadcast) ----------------
+    def set_weights(self, params) -> bool:
+        self._params = jax.tree.map(jnp.asarray, params)
+        return True
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self._params)
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect ``num_steps`` per sub-env; returns a columnar batch with
+        bootstrap values for GAE (shape [T, N, ...] flattened to [T*N, ...]
+        AFTER advantage computation by the algorithm — kept 2D here)."""
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N, self.spec.observation_dim), np.float32)
+        act_shape = (T, N) if self.spec.discrete else (T, N, self.spec.action_dim)
+        act_buf = np.zeros(act_shape, np.float32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            obs = np.asarray(self._obs, np.float32).reshape(N, -1)
+            action, logp, value = self._sample_fn(self._params, jnp.asarray(obs), sub)
+            action_np = np.asarray(action)
+            env_action = action_np.astype(np.int64) if self.spec.discrete else action_np
+            next_obs, reward, terminated, truncated, _ = self._envs.step(env_action)
+            done = np.logical_or(terminated, truncated)
+
+            obs_buf[t] = obs
+            act_buf[t] = action_np
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            rew_buf[t] = reward
+            # GAE must not bootstrap across true terminations; truncations
+            # keep bootstrapping (gymnasium autoreset handles env state).
+            done_buf[t] = terminated.astype(np.float32)
+
+            self._ep_returns += reward
+            self._ep_lens += 1
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._completed_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+            self._obs = next_obs
+
+        # bootstrap value of the final observation
+        last_obs = np.asarray(self._obs, np.float32).reshape(N, -1)
+        out = self.module.forward_inference(self._params, jnp.asarray(last_obs))
+        last_val = np.asarray(out["vf_preds"])
+
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "terminateds": done_buf,
+            "bootstrap_value": last_val,
+        }
+
+    def get_metrics(self) -> Dict[str, float]:
+        completed, self._completed = self._completed, []
+        lens, self._completed_lens = self._completed_lens, []
+        return {
+            "episode_return_mean": float(np.mean(completed)) if completed else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+            "num_episodes": float(len(completed)),
+        }
+
+    def stop(self) -> None:
+        self._envs.close()

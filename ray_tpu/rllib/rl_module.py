@@ -1,0 +1,140 @@
+"""RLModule — the policy/value network abstraction (JAX).
+
+Analog of the reference's new-stack ``rllib/core/rl_module/rl_module.py``:
+an RLModule owns the network and exposes ``forward_inference`` /
+``forward_exploration`` / ``forward_train``. The JAX implementation keeps
+params as an explicit pytree (functional — the module is stateless math, the
+Learner owns the params), so the same module runs in env-runner actors (CPU,
+small batch) and learners (TPU mesh, big batch) without code changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Reference: ``rl_module.RLModuleSpec`` — how to build a module."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+    discrete: bool = True
+    free_log_std: bool = True  # Box spaces: state-independent log-std
+
+
+class RLModule:
+    """Functional actor-critic MLP."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    # -- params --------------------------------------------------------------
+    def init_params(self, key: jax.Array) -> Dict:
+        s = self.spec
+        dims = (s.observation_dim,) + s.hidden
+        keys = jax.random.split(key, len(dims) + 2)
+        torso = []
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            w = jax.random.normal(keys[i], (a, b)) * np.sqrt(2.0 / a)
+            torso.append({"w": w, "b": jnp.zeros((b,))})
+        out_dim = s.action_dim if s.discrete else s.action_dim
+        params = {
+            "torso": torso,
+            "pi": {
+                "w": jax.random.normal(keys[-2], (dims[-1], out_dim)) * 0.01,
+                "b": jnp.zeros((out_dim,)),
+            },
+            "vf": {
+                "w": jax.random.normal(keys[-1], (dims[-1], 1)) * 1.0,
+                "b": jnp.zeros((1,)),
+            },
+        }
+        if not s.discrete and s.free_log_std:
+            params["log_std"] = jnp.zeros((s.action_dim,))
+        return params
+
+    # -- forward passes ------------------------------------------------------
+    def _torso(self, params: Dict, obs: jax.Array) -> jax.Array:
+        h = obs
+        for layer in params["torso"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        return h
+
+    def forward_train(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        """Returns action-dist inputs + value estimates."""
+        h = self._torso(params, obs)
+        logits = h @ params["pi"]["w"] + params["pi"]["b"]
+        value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        out = {"action_dist_inputs": logits, "vf_preds": value}
+        if not self.spec.discrete and self.spec.free_log_std:
+            out["log_std"] = jnp.broadcast_to(params["log_std"], logits.shape)
+        return out
+
+    forward_inference = forward_train
+    forward_exploration = forward_train
+
+    # -- distributions -------------------------------------------------------
+    def sample_action(
+        self, params: Dict, obs: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(action, logp, value) under the exploration policy."""
+        out = self.forward_exploration(params, obs)
+        logits = out["action_dist_inputs"]
+        if self.spec.discrete:
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), action
+            ]
+        else:
+            std = jnp.exp(out["log_std"])
+            noise = jax.random.normal(key, logits.shape)
+            action = logits + std * noise
+            logp = jnp.sum(
+                -0.5 * (noise**2) - out["log_std"] - 0.5 * jnp.log(2 * jnp.pi), axis=-1
+            )
+        return action, logp, out["vf_preds"]
+
+    def logp_and_entropy(
+        self, params: Dict, obs: jax.Array, actions: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        out = self.forward_train(params, obs)
+        logits = out["action_dist_inputs"]
+        if self.spec.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            logp = logp_all[jnp.arange(logits.shape[0]), actions.astype(jnp.int32)]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        else:
+            std = jnp.exp(out["log_std"])
+            logp = jnp.sum(
+                -0.5 * ((actions - logits) / std) ** 2
+                - out["log_std"]
+                - 0.5 * jnp.log(2 * jnp.pi),
+                axis=-1,
+            )
+            entropy = jnp.sum(out["log_std"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+        return logp, entropy, out["vf_preds"]
+
+
+def spec_for_env(env) -> RLModuleSpec:
+    """Build a spec from a gymnasium env's spaces."""
+    import gymnasium as gym
+
+    obs_space = env.observation_space
+    act_space = env.action_space
+    if hasattr(obs_space, "shape") and obs_space.shape:
+        obs_dim = int(np.prod(obs_space.shape))
+    else:
+        obs_dim = obs_space.n
+    if isinstance(act_space, gym.spaces.Discrete):
+        return RLModuleSpec(observation_dim=obs_dim, action_dim=int(act_space.n), discrete=True)
+    return RLModuleSpec(
+        observation_dim=obs_dim, action_dim=int(np.prod(act_space.shape)), discrete=False
+    )
