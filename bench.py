@@ -38,9 +38,11 @@ def main():
     mesh = make_mesh(MeshSpec(data=-1), devices=devices)
     rules = ShardingRules()
 
+    import os
+    attn = os.environ.get("RT_BENCH_ATTN", "dense")
     if on_tpu:
-        cfg = transformer.gpt2_small(max_seq_len=1024, remat=True)
-        batch_per_chip, seq = 8, 1024
+        cfg = transformer.gpt2_small(max_seq_len=1024, remat=os.environ.get("RT_BENCH_REMAT", "1") == "1", attn_impl=attn)
+        batch_per_chip, seq = int(os.environ.get("RT_BENCH_BATCH", "16")), 1024
         steps, warmup = 20, 3
     else:
         # CPU smoke shape: same code path, tiny sizes.

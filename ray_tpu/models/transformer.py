@@ -183,6 +183,15 @@ def _dense_attention(q, k, v, *, scale: float):
 
 def _make_attention(config: TransformerConfig, mesh: Optional[Mesh]):
     scale = 1.0 / config.head_dim ** 0.5
+    if config.attn_impl == "flash":
+        import jax as _jax
+
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        interpret = _jax.default_backend() != "tpu"
+        return lambda q, k, v: flash_attention(
+            q, k, v, True, scale, 128, 128, interpret
+        )
     if config.attn_impl == "dense" or mesh is None:
         return functools.partial(_dense_attention, scale=scale)
     if config.attn_impl == "ring":
