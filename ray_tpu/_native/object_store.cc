@@ -1,0 +1,355 @@
+// Shared-memory object store — the plasma analog (reference:
+// src/ray/object_manager/plasma/{store.cc,dlmalloc.cc}: a per-node
+// shared-memory arena at /dev/shm so worker processes exchange large buffers
+// zero-copy; reference mounts it the same way, raylet main.cc:84).
+//
+// Design: one POSIX shm segment = [StoreHeader | ObjectEntry table | data
+// arena]. Allocation is first-fit over an in-shm free list with coalescing on
+// free (the role dlmalloc plays in the reference, sized down to what a
+// host-RAM object plane needs). All state lives IN the segment, guarded by a
+// process-shared mutex, so any process that shm_open()s the segment is a
+// full peer (create/seal/get/release/delete) with no daemon in the loop.
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in the image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
+constexpr uint32_t kIdSize = 20;                  // ObjectID bytes (reference id.h)
+
+struct ObjectEntry {
+  uint8_t id[kIdSize];
+  uint64_t offset;    // data offset from arena base
+  uint64_t size;
+  int64_t refcount;   // get/release pins; delete only when 0
+  uint8_t sealed;     // visible to get() only when sealed
+  uint8_t used;
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+  int64_t next;  // index into free_blocks, -1 = end
+};
+
+struct StoreHeader {
+  uint64_t magic;
+  uint64_t capacity;        // arena bytes
+  uint64_t arena_offset;    // from segment base
+  uint32_t max_entries;
+  uint32_t max_free_blocks;
+  int64_t free_head;        // index into free block table
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+};
+
+struct Store {
+  int fd;
+  void* base;
+  uint64_t total_size;
+  StoreHeader* hdr;
+  ObjectEntry* entries;
+  FreeBlock* free_blocks;
+  uint8_t* arena;
+};
+
+uint64_t align8(uint64_t v) { return (v + 7) & ~7ULL; }
+
+ObjectEntry* find_entry(Store* s, const uint8_t* id) {
+  for (uint32_t i = 0; i < s->hdr->max_entries; i++) {
+    ObjectEntry* e = &s->entries[i];
+    if (e->used && memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+ObjectEntry* alloc_entry(Store* s) {
+  for (uint32_t i = 0; i < s->hdr->max_entries; i++) {
+    if (!s->entries[i].used) return &s->entries[i];
+  }
+  return nullptr;
+}
+
+// First-fit allocation from the free list.
+int64_t arena_alloc(Store* s, uint64_t size, uint64_t* out_offset) {
+  size = align8(size);
+  int64_t* prev_link = &s->hdr->free_head;
+  int64_t idx = s->hdr->free_head;
+  while (idx >= 0) {
+    FreeBlock* b = &s->free_blocks[idx];
+    if (b->size >= size) {
+      *out_offset = b->offset;
+      if (b->size == size) {
+        *prev_link = b->next;
+        b->size = 0;  // slot free for reuse
+      } else {
+        b->offset += size;
+        b->size -= size;
+      }
+      s->hdr->bytes_in_use += size;
+      return 0;
+    }
+    prev_link = &b->next;
+    idx = b->next;
+  }
+  return -1;  // out of memory
+}
+
+void arena_free(Store* s, uint64_t offset, uint64_t size) {
+  size = align8(size);
+  s->hdr->bytes_in_use -= size;
+  // walk the offset-sorted free list to the insertion point
+  int64_t prev = -1;
+  int64_t idx = s->hdr->free_head;
+  while (idx >= 0 && s->free_blocks[idx].offset < offset) {
+    prev = idx;
+    idx = s->free_blocks[idx].next;
+  }
+  bool merge_next = (idx >= 0 && offset + size == s->free_blocks[idx].offset);
+  bool merge_prev =
+      (prev >= 0 && s->free_blocks[prev].offset + s->free_blocks[prev].size == offset);
+  if (merge_prev && merge_next) {
+    s->free_blocks[prev].size += size + s->free_blocks[idx].size;
+    s->free_blocks[prev].next = s->free_blocks[idx].next;
+    s->free_blocks[idx].size = 0;
+    return;
+  }
+  if (merge_prev) {
+    s->free_blocks[prev].size += size;
+    return;
+  }
+  if (merge_next) {
+    s->free_blocks[idx].offset = offset;
+    s->free_blocks[idx].size += size;
+    return;
+  }
+  // new free block in the first empty slot
+  for (uint32_t i = 0; i < s->hdr->max_free_blocks; i++) {
+    if (s->free_blocks[i].size == 0) {
+      s->free_blocks[i].offset = offset;
+      s->free_blocks[i].size = size;
+      s->free_blocks[i].next = idx;
+      if (prev >= 0) {
+        s->free_blocks[prev].next = i;
+      } else {
+        s->hdr->free_head = i;
+      }
+      return;
+    }
+  }
+  // free-block table exhausted: leak the space (bounded by table size)
+}
+
+class Lock {
+ public:
+  explicit Lock(Store* s) : s_(s) {
+    int rc = pthread_mutex_lock(&s_->hdr->mutex);
+    if (rc == EOWNERDEAD) {
+      // A peer died holding the lock; the robust mutex hands it to us in an
+      // inconsistent state. Mark it consistent so mutual exclusion survives
+      // (store metadata may be mid-update, but every mutation here is
+      // small and idempotent enough that the next ops re-establish it).
+      pthread_mutex_consistent(&s_->hdr->mutex);
+    }
+  }
+  ~Lock() { pthread_mutex_unlock(&s_->hdr->mutex); }
+
+ private:
+  Store* s_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store segment. Returns handle or null.
+void* rt_store_create(const char* name, uint64_t capacity, uint32_t max_entries) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+
+  uint32_t max_free = max_entries * 2;
+  uint64_t entries_off = align8(sizeof(StoreHeader));
+  uint64_t free_off = align8(entries_off + sizeof(ObjectEntry) * max_entries);
+  uint64_t arena_off = align8(free_off + sizeof(FreeBlock) * max_free);
+  uint64_t total = arena_off + capacity;
+  if (ftruncate(fd, total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = base;
+  s->total_size = total;
+  s->hdr = static_cast<StoreHeader*>(base);
+  s->entries = reinterpret_cast<ObjectEntry*>(static_cast<char*>(base) + entries_off);
+  s->free_blocks = reinterpret_cast<FreeBlock*>(static_cast<char*>(base) + free_off);
+  s->arena = reinterpret_cast<uint8_t*>(base) + arena_off;
+
+  memset(s->hdr, 0, arena_off);
+  s->hdr->magic = kMagic;
+  s->hdr->capacity = capacity;
+  s->hdr->arena_offset = arena_off;
+  s->hdr->max_entries = max_entries;
+  s->hdr->max_free_blocks = max_free;
+  // one big free block
+  s->free_blocks[0] = {0, capacity, -1};
+  s->hdr->free_head = 0;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&s->hdr->mutex, &attr);
+  return s;
+}
+
+// Open an existing segment (peer process).
+void* rt_store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  StoreHeader* hdr = static_cast<StoreHeader*>(base);
+  if (hdr->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = base;
+  s->total_size = st.st_size;
+  s->hdr = hdr;
+  uint64_t entries_off = align8(sizeof(StoreHeader));
+  uint64_t free_off = align8(entries_off + sizeof(ObjectEntry) * hdr->max_entries);
+  s->entries = reinterpret_cast<ObjectEntry*>(static_cast<char*>(base) + entries_off);
+  s->free_blocks = reinterpret_cast<FreeBlock*>(static_cast<char*>(base) + free_off);
+  s->arena = reinterpret_cast<uint8_t*>(base) + hdr->arena_offset;
+  return s;
+}
+
+// Allocate an object buffer (unsealed). Returns pointer to data or null.
+// (reference: plasma Create — two-phase create/seal)
+void* rt_store_create_object(void* handle, const uint8_t* id, uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  if (find_entry(s, id)) return nullptr;  // already exists
+  ObjectEntry* e = alloc_entry(s);
+  if (!e) return nullptr;
+  uint64_t offset;
+  if (arena_alloc(s, size, &offset) != 0) return nullptr;
+  memcpy(e->id, id, kIdSize);
+  e->offset = offset;
+  e->size = size;
+  e->refcount = 1;  // creator holds a pin until seal+release
+  e->sealed = 0;
+  e->used = 1;
+  s->hdr->num_objects++;
+  return s->arena + offset;
+}
+
+int rt_store_seal(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  ObjectEntry* e = find_entry(s, id);
+  if (!e) return -1;
+  e->sealed = 1;
+  return 0;
+}
+
+// Get a sealed object: returns data pointer, fills size; pins the object.
+void* rt_store_get(void* handle, const uint8_t* id, uint64_t* size_out) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  ObjectEntry* e = find_entry(s, id);
+  if (!e || !e->sealed) return nullptr;
+  e->refcount++;
+  *size_out = e->size;
+  return s->arena + e->offset;
+}
+
+int rt_store_release(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  ObjectEntry* e = find_entry(s, id);
+  if (!e) return -1;
+  if (e->refcount > 0) e->refcount--;
+  return 0;
+}
+
+int rt_store_contains(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  ObjectEntry* e = find_entry(s, id);
+  return (e && e->sealed) ? 1 : 0;
+}
+
+// Delete when refcount==0 (reference: eviction only of unpinned objects).
+int rt_store_delete(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  ObjectEntry* e = find_entry(s, id);
+  if (!e) return -1;
+  if (e->refcount > 0) return -2;  // pinned
+  arena_free(s, e->offset, e->size);
+  e->used = 0;
+  s->hdr->num_objects--;
+  return 0;
+}
+
+uint64_t rt_store_bytes_in_use(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  return s->hdr->bytes_in_use;
+}
+
+uint64_t rt_store_num_objects(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Lock lock(s);
+  return s->hdr->num_objects;
+}
+
+uint64_t rt_store_capacity(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  return s->hdr->capacity;
+}
+
+void rt_store_close(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  munmap(s->base, s->total_size);
+  close(s->fd);
+  delete s;
+}
+
+int rt_store_destroy(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -56,6 +56,11 @@ class Config:
     object_store_memory = _Flag(2 * 1024 * 1024 * 1024)
     # Spill directory for objects evicted from the shm store.
     object_spilling_dir = _Flag("/tmp/ray_tpu_spill")
+    # Use the native C++ shared-memory arena for large object buffers
+    # (the plasma path; falls back to heap bytes when the lib can't build).
+    use_native_store = _Flag(True)
+    # Buffers at or above this size go to the native shm arena.
+    native_store_threshold = _Flag(64 * 1024)
 
     # -- scheduling -----------------------------------------------------------
     # Hybrid policy threshold: below this utilization prefer packing on the

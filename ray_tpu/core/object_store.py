@@ -27,14 +27,21 @@ logger = get_logger("object_store")
 
 
 class StoredObject:
-    __slots__ = ("serialized", "size", "create_time", "spilled_path", "pinned")
+    __slots__ = (
+        "serialized", "size", "create_time", "spilled_path", "pinned", "shm_keys",
+    )
 
-    def __init__(self, serialized: Optional[SerializedObject]):
+    def __init__(self, serialized: Optional[SerializedObject], size: int | None = None):
         self.serialized = serialized
-        self.size = serialized.total_size() if serialized is not None else 0
+        if size is not None:
+            self.size = size
+        else:
+            self.size = serialized.total_size() if serialized is not None else 0
         self.create_time = time.monotonic()
         self.spilled_path = None
         self.pinned = 0
+        # buffer index -> shm key for buffers held in the native arena
+        self.shm_keys: Optional[Dict[int, bytes]] = None
 
 
 class MemoryStore:
@@ -49,6 +56,22 @@ class MemoryStore:
         self._used = 0
         self._spill_dir = config().object_spilling_dir
         self._deser_cache: Dict[ObjectID, object] = {}
+        # Native shm arena (the plasma plane) for large buffers; optional.
+        self._native = None
+        self._native_threshold = config().native_store_threshold
+        if config().use_native_store:
+            try:
+                from ray_tpu.core.native_store import NativeObjectStore
+
+                self._native = NativeObjectStore(
+                    f"rtpu_store_{os.getpid()}_{id(self):x}",
+                    capacity=self._capacity,
+                )
+            except Exception as e:  # lib unavailable: heap-bytes fallback
+                logger.debug("native store unavailable, using heap: %s", e)
+        # arena blocks whose delete was refused (reader still pinned);
+        # retried on subsequent puts and deletes
+        self._shm_garbage: set = set()
 
     # -- write path -----------------------------------------------------------
 
@@ -56,16 +79,44 @@ class MemoryStore:
         # Copy out-of-band buffers: stored objects must not alias caller
         # memory (a numpy array mutated after put() would silently mutate the
         # stored object — the reference copies into plasma for the same
-        # reason).
-        if serialized.buffers:
-            serialized = SerializedObject(
-                header=serialized.header,
-                buffers=[bytes(memoryview(b).cast("B")) for b in serialized.buffers],
-            )
+        # reason). Large buffers copy ONCE into the native shm arena (the
+        # plasma path: consumers map them zero-copy); small ones stay heap
+        # bytes inline with the header.
         with self._lock:
             if object_id in self._objects:
                 return  # idempotent: objects are immutable
-            entry = StoredObject(serialized)
+        self._sweep_shm_garbage()
+        shm_keys: Optional[Dict[int, bytes]] = None
+        if serialized.buffers:
+            kept: list = []
+            for i, b in enumerate(serialized.buffers):
+                mv = memoryview(b).cast("B")
+                if self._native is not None and len(mv) >= self._native_threshold:
+                    key = object_id.binary()[:16] + i.to_bytes(4, "big")
+                    try:
+                        self._native.put(key, mv)
+                        if shm_keys is None:
+                            shm_keys = {}
+                        shm_keys[i] = key
+                        kept.append(b"")  # placeholder, re-materialized on get
+                        continue
+                    except MemoryError:
+                        pass  # arena full or raced duplicate: heap copy
+                kept.append(bytes(mv))
+            serialized = SerializedObject(header=serialized.header, buffers=kept)
+        # heap budget counts only heap-resident bytes; shm bytes have their
+        # own budget (the arena itself raises MemoryError when full)
+        heap_size = serialized.total_size()
+        with self._lock:
+            if object_id in self._objects:
+                # raced duplicate: reclaim any arena blocks we just wrote
+                if shm_keys and self._native is not None:
+                    for key in shm_keys.values():
+                        if not self._native.delete(key):
+                            self._shm_garbage.add(key)
+                return
+            entry = StoredObject(serialized, size=heap_size)
+            entry.shm_keys = shm_keys
             if self._used + entry.size > self._capacity:
                 self._evict_locked(self._used + entry.size - self._capacity)
             self._objects[object_id] = entry
@@ -80,6 +131,13 @@ class MemoryStore:
 
     def put(self, object_id: ObjectID, value) -> None:
         self.put_serialized(object_id, serialize(value))
+
+    def _sweep_shm_garbage(self) -> None:
+        if self._native is None or not self._shm_garbage:
+            return
+        for key in list(self._shm_garbage):
+            if self._native.delete(key):
+                self._shm_garbage.discard(key)
 
     # -- read path ------------------------------------------------------------
 
@@ -100,6 +158,15 @@ class MemoryStore:
             entry = self._objects[object_id]
             if entry.serialized is None:
                 entry = self._restore_locked(object_id, entry)
+            if entry.shm_keys:
+                # re-materialize shm-backed buffers as pinned zero-copy views
+                buffers = list(entry.serialized.buffers)
+                for i, key in entry.shm_keys.items():
+                    view = self._native.get_view(key) if self._native else None
+                    if view is None:
+                        raise ObjectLostError(object_id)
+                    buffers[i] = view
+                return SerializedObject(header=entry.serialized.header, buffers=buffers)
             return entry.serialized
 
     def get(self, object_id: ObjectID, timeout: float | None = None):
@@ -151,6 +218,7 @@ class MemoryStore:
     # -- lifecycle ------------------------------------------------------------
 
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        self._sweep_shm_garbage()
         with self._lock:
             for oid in object_ids:
                 entry = self._objects.pop(oid, None)
@@ -160,6 +228,14 @@ class MemoryStore:
                         # _used tracks in-memory bytes only; spilled entries
                         # were already subtracted at spill time.
                         self._used -= entry.size
+                    if entry.shm_keys and self._native is not None:
+                        for key in entry.shm_keys.values():
+                            # refused while a reader still pins the buffer →
+                            # parked in _shm_garbage, retried on later
+                            # puts/deletes (plasma defers eviction of pinned
+                            # objects the same way)
+                            if not self._native.delete(key):
+                                self._shm_garbage.add(key)
                     if entry.spilled_path:
                         try:
                             os.unlink(entry.spilled_path)
@@ -175,6 +251,15 @@ class MemoryStore:
         with self._lock:
             if object_id in self._objects:
                 self._objects[object_id].pinned -= 1
+
+    def close(self) -> None:
+        """Tear down the native shm segment (runtime shutdown)."""
+        if self._native is not None:
+            try:
+                self._native.destroy()
+            except Exception:
+                pass
+            self._native = None
 
     def stats(self) -> dict:
         with self._lock:
@@ -206,6 +291,8 @@ class MemoryStore:
             if freed >= bytes_needed:
                 break
             entry = self._objects[oid]
+            if entry.shm_keys:
+                continue  # shm-backed: lives outside the heap budget
             path = os.path.join(self._spill_dir, oid.hex())
             with open(path, "wb") as f:
                 f.write(entry.serialized.to_bytes())

@@ -1133,6 +1133,10 @@ class Runtime:
             except Exception:
                 pass
         self.gcs.finish_job(self.job_id)
+        try:
+            self.store.close()
+        except Exception:
+            pass
 
 
 class _ActorCreationState(TaskState):

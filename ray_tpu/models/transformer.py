@@ -50,7 +50,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # storage dtype
     pos: str = "learned"               # "learned" (gpt2) | "rope" (llama-ish)
     tie_embeddings: bool = True
-    attn_impl: str = "dense"           # "dense" | "ring" | "ulysses"
+    attn_impl: str = "dense"           # "dense" | "flash" | "ring" | "ulysses"
     remat: bool = False                # jax.checkpoint each block (HBM↔FLOPs)
     vocab_multiple: int = 128
 
