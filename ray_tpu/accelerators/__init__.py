@@ -1,0 +1,17 @@
+from ray_tpu.accelerators.tpu import (
+    TPUAcceleratorManager,
+    detect_tpu,
+    get_current_pod_name,
+    get_current_pod_worker_count,
+    num_tpu_chips,
+    tpu_resources,
+)
+
+__all__ = [
+    "TPUAcceleratorManager",
+    "detect_tpu",
+    "tpu_resources",
+    "num_tpu_chips",
+    "get_current_pod_name",
+    "get_current_pod_worker_count",
+]

@@ -369,14 +369,9 @@ class Runtime:
         if "TPU" in resources:
             return
         try:
-            import jax
+            from ray_tpu.accelerators import tpu_resources
 
-            devs = [d for d in jax.devices() if d.platform != "cpu"]
-            if devs:
-                resources["TPU"] = float(len(devs))
-                kind = getattr(devs[0], "device_kind", "TPU").upper().replace(" ", "-")
-                resources[f"TPU-{kind}"] = float(len(devs))
-                resources["TPU-head"] = 1.0
+            resources.update(tpu_resources())
         except Exception:
             pass
 

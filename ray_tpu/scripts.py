@@ -1,0 +1,111 @@
+"""CLI — ``python -m ray_tpu.scripts <cmd>`` (or the ``ray-tpu`` entry point).
+
+Analog of the reference's ``python/ray/scripts/scripts.py`` (``ray
+status/list/summary/timeline``) for the in-runtime cluster model. argparse
+only — no click dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _init_from_args(args) -> None:
+    import ray_tpu
+
+    ray_tpu.init(
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        num_nodes=args.num_nodes,
+    )
+
+
+def cmd_status(args) -> int:
+    from ray_tpu.util import state
+
+    _init_from_args(args)
+    print(json.dumps(state.cluster_summary(), indent=2, default=str))
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    _init_from_args(args)
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "jobs": state.list_jobs,
+        "placement-groups": state.list_placement_groups,
+    }[args.resource]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state
+
+    _init_from_args(args)
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors}[args.resource]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+
+    _init_from_args(args)
+    trace = ray_tpu.timeline()
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output}")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import runpy
+
+    sys.argv = ["bench.py"]
+    runpy.run_path("bench.py", run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray-tpu", description="ray_tpu CLI")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--num-nodes", type=int, default=1)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster summary")
+
+    p_list = sub.add_parser("list", help="list cluster state")
+    p_list.add_argument(
+        "resource",
+        choices=["nodes", "actors", "tasks", "objects", "jobs", "placement-groups"],
+    )
+
+    p_sum = sub.add_parser("summary", help="state counts")
+    p_sum.add_argument("resource", choices=["tasks", "actors"])
+
+    p_tl = sub.add_parser("timeline", help="dump chrome trace")
+    p_tl.add_argument("-o", "--output", default="timeline.json")
+
+    sub.add_parser("bench", help="run the headline benchmark")
+
+    args = parser.parse_args(argv)
+    return {
+        "status": cmd_status,
+        "list": cmd_list,
+        "summary": cmd_summary,
+        "timeline": cmd_timeline,
+        "bench": cmd_bench,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

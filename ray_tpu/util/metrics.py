@@ -1,0 +1,151 @@
+"""User-defined metrics — Counter / Gauge / Histogram.
+
+Analog of the reference's ``python/ray/util/metrics.py`` (Cython-backed there,
+process-local registry here) with a Prometheus text exposition endpoint
+(what the reference's metrics agent exports for scrape —
+``_private/metrics_agent.py:483``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        unknown = set(tags) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in tag_keys {self._tag_keys}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in tag_keys {self._tag_keys}")
+        return tuple(sorted(merged.items()))
+
+    def _prom_lines(self) -> List[str]:  # pragma: no cover - overridden
+        return []
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        with self._lock:
+            self._values[self._tag_tuple(tags)] += value
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(self._tag_tuple(tags), 0.0)
+
+    def _prom_lines(self):
+        out = [f"# TYPE {self._name} counter"]
+        with self._lock:
+            for tags, v in self._values.items():
+                out.append(f"{self._name}{_fmt_tags(tags)} {v}")
+        return out
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._tag_tuple(tags)] = float(value)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(self._tag_tuple(tags), 0.0)
+
+    def _prom_lines(self):
+        out = [f"# TYPE {self._name} gauge"]
+        with self._lock:
+            for tags, v in self._values.items():
+                out.append(f"{self._name}{_fmt_tags(tags)} {v}")
+        return out
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be a sorted non-empty sequence")
+        self._bounds = list(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = defaultdict(float)
+        self._totals: Dict[Tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._tag_tuple(tags)
+        with self._lock:
+            buckets = self._counts.setdefault(key, [0] * (len(self._bounds) + 1))
+            for i, b in enumerate(self._bounds):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def _prom_lines(self):
+        out = [f"# TYPE {self._name} histogram"]
+        with self._lock:
+            for key, buckets in self._counts.items():
+                cum = 0
+                for i, b in enumerate(self._bounds):
+                    cum += buckets[i]
+                    tags = key + (("le", str(b)),)
+                    out.append(f"{self._name}_bucket{_fmt_tags(tags)} {cum}")
+                cum += buckets[-1]
+                out.append(f"{self._name}_bucket{_fmt_tags(key + (('le', '+Inf'),))} {cum}")
+                out.append(f"{self._name}_sum{_fmt_tags(key)} {self._sums[key]}")
+                out.append(f"{self._name}_count{_fmt_tags(key)} {self._totals[key]}")
+        return out
+
+
+def _fmt_tags(tags: Tuple[Tuple[str, str], ...]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition of every registered metric (the scrape body the
+    reference's agent serves)."""
+    with _registry_lock:
+        metrics = list(_registry)
+    lines: List[str] = []
+    for m in metrics:
+        lines.extend(m._prom_lines())
+    return "\n".join(lines) + ("\n" if lines else "")

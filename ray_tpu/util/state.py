@@ -1,0 +1,109 @@
+"""State API — ``list_tasks/actors/objects/nodes`` + summaries.
+
+Analog of the reference's ``python/ray/util/state/`` (``ray list ...``,
+aggregated by ``dashboard/state_aggregator.py`` from GCS task events + raylet
+stats). Sources here: the GCS's task-event log, actor/node/job tables, and
+object-store stats.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.runtime import get_runtime
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    return [
+        {
+            "node_id": n.node_id.hex(),
+            "state": "ALIVE" if n.alive else "DEAD",
+            "resources_total": dict(n.resources),
+            "labels": dict(n.labels),
+        }
+        for n in rt.gcs.nodes.values()
+    ]
+
+
+def list_actors(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    out = []
+    for info in rt.gcs.actors.values():
+        row = {
+            "actor_id": info.actor_id.hex(),
+            "class_name": info.class_name,
+            "state": info.state,
+            "name": info.name or "",
+            "node_id": info.node_id.hex() if info.node_id else "",
+            "restarts": getattr(info, "num_restarts", 0),
+        }
+        if state is None or row["state"] == state:
+            out.append(row)
+    return out
+
+
+def list_tasks(*, state: Optional[str] = None, limit: int = 10_000) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    latest: Dict[str, Dict[str, Any]] = {}
+    for e in rt.gcs.task_events():
+        tid = e.get("task_id")
+        cur = latest.setdefault(tid, {"task_id": tid})
+        cur["name"] = e.get("name", cur.get("name", ""))
+        cur["state"] = e.get("state", cur.get("state", ""))
+        cur["node_id"] = e.get("node_id", cur.get("node_id", ""))
+        if e.get("duration") is not None:
+            cur["duration_s"] = e["duration"]
+    rows = list(latest.values())
+    if state is not None:
+        rows = [r for r in rows if r.get("state") == state]
+    return rows[:limit]
+
+
+def list_objects(limit: int = 10_000) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    stats = rt.store.stats()
+    return [
+        {
+            "num_objects": stats["num_objects"],
+            "used_bytes": stats["used_bytes"],
+            "capacity_bytes": stats["capacity_bytes"],
+        }
+    ]
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    return [
+        {"job_id": j.job_id.hex(), "status": j.status, "entrypoint": j.entrypoint}
+        for j in rt.gcs.jobs.values()
+    ]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    from ray_tpu import placement_group_table
+
+    return list(placement_group_table().values())
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """``ray summary tasks``-style state counts."""
+    return dict(_Counter(t.get("state", "UNKNOWN") for t in list_tasks()))
+
+
+def summarize_actors() -> Dict[str, int]:
+    return dict(_Counter(a["state"] for a in list_actors()))
+
+
+def cluster_summary() -> Dict[str, Any]:
+    rt = get_runtime()
+    return {
+        "nodes": len(rt.gcs.nodes),
+        "alive_nodes": len(rt.gcs.alive_nodes()),
+        "resources_total": rt.gcs.cluster_resources(),
+        "resources_available": rt.scheduler.available_resources(),
+        "tasks": summarize_tasks(),
+        "actors": summarize_actors(),
+        "object_store": rt.store.stats(),
+    }
