@@ -1,0 +1,19 @@
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, bin_pack
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    NodeInstance,
+    NodeProvider,
+    NodeType,
+    TPUPodNodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "bin_pack",
+    "NodeProvider",
+    "NodeType",
+    "NodeInstance",
+    "FakeNodeProvider",
+    "TPUPodNodeProvider",
+]

@@ -1,0 +1,130 @@
+"""Node providers — the cloud seam of the autoscaler.
+
+Analog of the reference's v2 provider layer
+(``python/ray/autoscaler/v2/instance_manager/``, cloud plugins under
+``python/ray/autoscaler/{gcp,aws,...}``, and the load-bearing test provider
+``_private/fake_multi_node/node_provider.py`` — SURVEY §4.3). The
+``FakeNodeProvider`` backs autoscaler tests by adding virtual nodes to the
+in-process runtime; ``TPUPodNodeProvider`` is the GCE/TPU-pod shape (API
+calls gated — zero-egress images stub them).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class NodeType:
+    """One launchable instance shape (reference: ``available_node_types`` in
+    the cluster YAML — ``autoscaler/ray-schema.json``)."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeInstance:
+    instance_id: str
+    node_type: str
+    resources: Dict[str, float]
+    status: str = "RUNNING"  # PENDING | RUNNING | TERMINATED
+    node_id: Optional[object] = None  # runtime NodeID once joined
+
+
+class NodeProvider:
+    """Reference: ``autoscaler/node_provider.py`` interface."""
+
+    def create_node(self, node_type: NodeType) -> NodeInstance:
+        raise NotImplementedError
+
+    def terminate_node(self, instance: NodeInstance) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Adds/removes virtual nodes on the live runtime (the single-host
+    multi-node trick — ``cluster_utils.py:135 Cluster``)."""
+
+    def __init__(self, runtime=None):
+        from ray_tpu.core.runtime import get_runtime
+
+        self._runtime = runtime or get_runtime()
+        self._instances: Dict[str, NodeInstance] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: NodeType) -> NodeInstance:
+        node_id = self._runtime.add_node(
+            resources=dict(node_type.resources),
+            labels={"node-type": node_type.name, **node_type.labels},
+        )
+        inst = NodeInstance(
+            instance_id=f"fake-{uuid.uuid4().hex[:8]}",
+            node_type=node_type.name,
+            resources=dict(node_type.resources),
+            node_id=node_id,
+        )
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+        return inst
+
+    def terminate_node(self, instance: NodeInstance) -> None:
+        with self._lock:
+            inst = self._instances.pop(instance.instance_id, None)
+        if inst is not None and inst.node_id is not None:
+            self._runtime.remove_node(inst.node_id)
+            inst.status = "TERMINATED"
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [i for i in self._instances.values() if i.status == "RUNNING"]
+
+
+class TPUPodNodeProvider(NodeProvider):
+    """GCE TPU-pod provider shape (reference: ``autoscaler/gcp/`` + TPU pod
+    handling). Actual GCE calls require credentials/egress; the command
+    surface is kept so a deployment can fill in ``_gcloud``."""
+
+    def __init__(self, project: str, zone: str, runtime_version: str = "tpu-ubuntu2204-base"):
+        self.project = project
+        self.zone = zone
+        self.runtime_version = runtime_version
+        self._instances: Dict[str, NodeInstance] = {}
+
+    def _gcloud(self, *args: str) -> str:  # pragma: no cover - needs egress
+        raise NotImplementedError(
+            "TPUPodNodeProvider requires GCE access; subclass and implement "
+            "_gcloud (e.g. `gcloud compute tpus tpu-vm ...`) for deployment"
+        )
+
+    def create_node(self, node_type: NodeType) -> NodeInstance:  # pragma: no cover
+        accel = node_type.labels.get("tpu-accelerator-type", "v5litepod-4")
+        name = f"rtpu-{uuid.uuid4().hex[:8]}"
+        self._gcloud(
+            "compute", "tpus", "tpu-vm", "create", name,
+            f"--zone={self.zone}", f"--accelerator-type={accel}",
+            f"--version={self.runtime_version}",
+        )
+        inst = NodeInstance(instance_id=name, node_type=node_type.name,
+                            resources=dict(node_type.resources))
+        self._instances[name] = inst
+        return inst
+
+    def terminate_node(self, instance: NodeInstance) -> None:  # pragma: no cover
+        self._gcloud(
+            "compute", "tpus", "tpu-vm", "delete", instance.instance_id,
+            f"--zone={self.zone}", "--quiet",
+        )
+        self._instances.pop(instance.instance_id, None)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        return [i for i in self._instances.values() if i.status == "RUNNING"]

@@ -58,6 +58,7 @@ def resolve_options(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Task
         max_pending_calls=merged.get("max_pending_calls", -1),
         lifetime=merged.get("lifetime"),
         namespace=merged.get("namespace"),
+        runtime_env=merged.get("runtime_env"),
         get_if_exists=merged.get("get_if_exists", False),
         concurrency_groups=merged.get("concurrency_groups") or {},
     )

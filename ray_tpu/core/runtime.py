@@ -344,6 +344,11 @@ class Runtime:
         self._seq_expected: Dict[tuple, int] = {}
         self._seq_buffer: Dict[tuple, Dict[int, TaskState]] = {}
         self._pg_manager = None  # set lazily by placement_group module
+        # autoscaler integration: when enabled, infeasible work parks instead
+        # of failing and is retried after cluster growth
+        self.autoscaling_enabled = False
+        self._infeasible: List[tuple] = []
+        self._infeasible_lock = threading.Lock()
         self._detached_actor_creation_specs: Dict[ActorID, TaskSpec] = {}
 
         base = dict(resources or {})
@@ -358,6 +363,19 @@ class Runtime:
         self.head_node_id = next(iter(self.nodes))
 
     # -- topology -------------------------------------------------------------
+
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Resource shapes of parked infeasible work (autoscaler input —
+        the analog of the demand the raylet reports to the autoscaler)."""
+        with self._infeasible_lock:
+            return [dict(req) for _, req in self._infeasible]
+
+    def retry_infeasible(self) -> None:
+        """Re-schedule parked work after cluster growth."""
+        with self._infeasible_lock:
+            parked, self._infeasible = self._infeasible, []
+        for state, _ in parked:
+            self._schedule(state)
 
     def _autodetect_tpu(self, resources: Dict[str, float]) -> None:
         """Detect local TPU chips and register them as named resources.
@@ -569,6 +587,12 @@ class Runtime:
         else:
             node_id = self.scheduler.best_node(request, strategy, preferred)
         if node_id is None or node_id not in self.nodes:
+            if self.autoscaling_enabled:
+                # Park until the autoscaler adds capacity (reference: tasks
+                # pend in the raylet while the autoscaler reacts to demand).
+                with self._infeasible_lock:
+                    self._infeasible.append((state, request.to_dict()))
+                return
             err = RuntimeError(
                 f"no feasible node for task {spec.function_name} "
                 f"(request={request.to_dict()}, cluster={self.gcs.cluster_resources()})"
@@ -663,7 +687,10 @@ class Runtime:
             if fn is None:
                 raise RuntimeError(f"function {spec.function_id} not found in GCS")
             args, kwargs = self._fetch_args(spec)
-            result = fn(*args, **kwargs)
+            from ray_tpu.runtime_env import applied as _renv
+
+            with _renv(spec.options.runtime_env):
+                result = fn(*args, **kwargs)
             self._store_results(state, result)
             self.gcs.record_task_event(
                 {"task_id": spec.task_id.hex(), "name": spec.function_name, "state": "FINISHED",

@@ -94,6 +94,9 @@ class TaskOptions:
     namespace: Optional[str] = None
     get_if_exists: bool = False
     concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    # Per-task/actor runtime environment (reference: runtime_env option in
+    # ray_option_utils.py; dict form of ray_tpu.runtime_env.RuntimeEnv)
+    runtime_env: Optional[Dict[str, Any]] = None
 
     def resource_set(self) -> ResourceSet:
         return ResourceSet(self.resources)

@@ -1,0 +1,157 @@
+"""Job submission — run driver scripts as supervised jobs.
+
+Analog of the reference's job API (``dashboard/modules/job/`` —
+``JobManager`` :529 spawning a ``JobSupervisor`` actor :142 that runs the
+entrypoint command; REST surface ``submit_job`` :875). The supervisor is an
+actor holding the subprocess; status/logs/stop flow through it; job metadata
+lives in the GCS job table.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class _JobSupervisor:
+    """Reference: ``job_manager.py:142 JobSupervisor`` — owns the driver
+    subprocess for one job."""
+
+    def __init__(self, job_id: str, entrypoint: str, env: Dict[str, str], log_path: str):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self.status = JobStatus.PENDING
+        self.returncode: Optional[int] = None
+        self._log_file = open(log_path, "wb")
+        child_env = {**os.environ, **env, "RAY_TPU_JOB_ID": job_id}
+        self._proc = subprocess.Popen(
+            entrypoint,
+            shell=True,
+            stdout=self._log_file,
+            stderr=subprocess.STDOUT,
+            env=child_env,
+            start_new_session=True,
+        )
+        self.status = JobStatus.RUNNING
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _wait(self):
+        self.returncode = self._proc.wait()
+        self._log_file.close()
+        if self.status != JobStatus.STOPPED:
+            self.status = (
+                JobStatus.SUCCEEDED if self.returncode == 0 else JobStatus.FAILED
+            )
+
+    def get_status(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "status": self.status,
+            "entrypoint": self.entrypoint,
+            "returncode": self.returncode,
+        }
+
+    def get_logs(self) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop(self) -> bool:
+        if self.status == JobStatus.RUNNING:
+            self.status = JobStatus.STOPPED
+            try:
+                os.killpg(os.getpgid(self._proc.pid), 15)
+            except Exception:
+                self._proc.terminate()
+            return True
+        return False
+
+
+class JobSubmissionClient:
+    """Reference: ``ray.job_submission.JobSubmissionClient`` surface
+    (submit_job / get_job_status / get_job_logs / stop_job / list_jobs /
+    wait — address-free: talks to the in-runtime supervisor actors)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._supervisors: Dict[str, Any] = {}
+        self._log_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_jobs")
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[Dict] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        env_vars = dict((runtime_env or {}).get("env_vars", {}))
+        working_dir = (runtime_env or {}).get("working_dir")
+        if working_dir:
+            env_vars["PYTHONPATH"] = (
+                working_dir + os.pathsep + env_vars.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
+            )
+            entrypoint = f"cd {working_dir} && {entrypoint}"
+        log_path = os.path.join(self._log_dir, f"{job_id}.log")
+        supervisor_cls = ray_tpu.remote(_JobSupervisor)
+        sup = supervisor_cls.options(num_cpus=0, name=f"_job_supervisor_{job_id}").remote(
+            job_id, entrypoint, env_vars, log_path
+        )
+        self._supervisors[job_id] = sup
+        return job_id
+
+    def _sup(self, job_id: str):
+        if job_id in self._supervisors:
+            return self._supervisors[job_id]
+        return ray_tpu.get_actor(f"_job_supervisor_{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).get_status.remote())["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        return ray_tpu.get(self._sup(job_id).get_status.remote())
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).get_logs.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._sup(job_id).stop.remote())
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return [
+            ray_tpu.get(sup.get_status.remote()) for sup in self._supervisors.values()
+        ]
+
+    def wait_until_finish(self, job_id: str, timeout_s: float = 120.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.1)
+        raise TimeoutError(f"job {job_id} not finished in {timeout_s}s")

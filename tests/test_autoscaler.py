@@ -1,0 +1,104 @@
+"""Autoscaler tests, modeled on the reference's autoscaler-v2 tests against
+fake instance providers (SURVEY §4.3): bin-packing, demand-driven upscale
+unparking infeasible tasks, min-workers, idle downscale."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FakeNodeProvider,
+    NodeType,
+    bin_pack,
+)
+
+
+class TestBinPack:
+    def test_packs_multiple_demands_per_node(self):
+        nt = NodeType("cpu4", {"CPU": 4}, max_workers=10)
+        launches = bin_pack([{"CPU": 1}] * 4, [nt], {})
+        assert launches == {"cpu4": 1}
+
+    def test_spills_to_second_node(self):
+        nt = NodeType("cpu4", {"CPU": 4}, max_workers=10)
+        launches = bin_pack([{"CPU": 3}, {"CPU": 3}], [nt], {})
+        assert launches == {"cpu4": 2}
+
+    def test_respects_max_workers(self):
+        nt = NodeType("cpu1", {"CPU": 1}, max_workers=2)
+        launches = bin_pack([{"CPU": 1}] * 5, [nt], {"cpu1": 1})
+        assert launches == {"cpu1": 1}
+
+    def test_picks_matching_type(self):
+        cpu = NodeType("cpu", {"CPU": 8}, max_workers=4)
+        tpu = NodeType("tpu", {"CPU": 4, "TPU": 4}, max_workers=4)
+        launches = bin_pack([{"TPU": 4}], [cpu, tpu], {})
+        assert launches == {"tpu": 1}
+
+
+class TestAutoscalerE2E:
+    def test_upscale_unparks_infeasible_task(self, ray_start_regular):
+        provider = FakeNodeProvider()
+        asc = Autoscaler(
+            provider,
+            AutoscalerConfig(
+                node_types=[NodeType("big", {"CPU": 2, "bignode": 1}, max_workers=2)],
+                update_interval_s=0.05,
+            ),
+        )
+        asc.start()
+        try:
+            @ray_tpu.remote(resources={"bignode": 0.5})
+            def needs_big():
+                return "ran-on-big"
+
+            # infeasible on the base cluster; autoscaler must add a node
+            result = ray_tpu.get(needs_big.remote(), timeout=30)
+            assert result == "ran-on-big"
+            assert len(provider.non_terminated_nodes()) >= 1
+        finally:
+            asc.stop()
+
+    def test_min_workers_satisfied_at_start(self, ray_start_regular):
+        provider = FakeNodeProvider()
+        asc = Autoscaler(
+            provider,
+            AutoscalerConfig(
+                node_types=[NodeType("warm", {"CPU": 1}, min_workers=2, max_workers=4)],
+                update_interval_s=0.05,
+            ),
+        )
+        asc.start()
+        try:
+            assert len(provider.non_terminated_nodes()) == 2
+        finally:
+            asc.stop()
+
+    def test_idle_nodes_terminated(self, ray_start_regular):
+        provider = FakeNodeProvider()
+        asc = Autoscaler(
+            provider,
+            AutoscalerConfig(
+                node_types=[NodeType("burst", {"CPU": 2, "burst": 2}, max_workers=2)],
+                update_interval_s=0.05,
+                idle_timeout_s=0.3,
+            ),
+        )
+        asc.start()
+        try:
+            @ray_tpu.remote(resources={"burst": 1})
+            def burst_work():
+                return 1
+
+            assert ray_tpu.get(burst_work.remote(), timeout=30) == 1
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(provider.non_terminated_nodes()) == 0:
+                    break
+                time.sleep(0.05)
+            assert len(provider.non_terminated_nodes()) == 0, "idle node not reclaimed"
+        finally:
+            asc.stop()

@@ -1,0 +1,107 @@
+"""Job submission + runtime-env tests, modeled on the reference's
+``dashboard/modules/job/tests`` and ``python/ray/tests/test_runtime_env*``.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+from ray_tpu.runtime_env import RuntimeEnv, applied
+
+
+class TestRuntimeEnv:
+    def test_validation(self, tmp_path):
+        env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path))
+        assert env["env_vars"] == {"A": "1"}
+        with pytest.raises(ValueError):
+            RuntimeEnv(bogus_field=1)
+        with pytest.raises(ValueError):
+            RuntimeEnv(working_dir="/nonexistent/dir")
+        with pytest.raises(TypeError):
+            RuntimeEnv(env_vars={"A": 1})
+
+    def test_deferred_plugins_flagged(self):
+        env = RuntimeEnv(pip=["requests"])
+        assert env.deferred_plugins() == ["pip"]
+
+    def test_applied_env_vars_restored(self):
+        os.environ.pop("RT_TEST_VAR", None)
+        with applied({"env_vars": {"RT_TEST_VAR": "inner"}}):
+            assert os.environ["RT_TEST_VAR"] == "inner"
+        assert "RT_TEST_VAR" not in os.environ
+
+    def test_task_runtime_env(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"MY_TASK_VAR": "hello"}})
+        def read_env():
+            return os.environ.get("MY_TASK_VAR")
+
+        assert ray_tpu.get(read_env.remote()) == "hello"
+        assert "MY_TASK_VAR" not in os.environ
+
+    def test_working_dir_on_sys_path(self, ray_start_regular, tmp_path):
+        mod = tmp_path / "my_renv_module.py"
+        mod.write_text("VALUE = 42\n")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+        def use_module():
+            import my_renv_module
+
+            return my_renv_module.VALUE
+
+        assert ray_tpu.get(use_module.remote()) == 42
+
+
+class TestJobSubmission:
+    def test_submit_and_succeed(self, ray_start_regular):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('job ran fine')\""
+        )
+        status = client.wait_until_finish(job_id, timeout_s=60)
+        assert status == JobStatus.SUCCEEDED
+        assert "job ran fine" in client.get_job_logs(job_id)
+
+    def test_failed_job_status(self, ray_start_regular):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"import sys; print('boom'); sys.exit(3)\""
+        )
+        status = client.wait_until_finish(job_id, timeout_s=60)
+        assert status == JobStatus.FAILED
+        info = client.get_job_info(job_id)
+        assert info["returncode"] == 3
+
+    def test_env_vars_reach_job(self, ray_start_regular):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"import os; print('VAR=' + os.environ['JOBVAR'])\"",
+            runtime_env={"env_vars": {"JOBVAR": "xyz"}},
+        )
+        client.wait_until_finish(job_id, timeout_s=60)
+        assert "VAR=xyz" in client.get_job_logs(job_id)
+
+    def test_stop_job(self, ray_start_regular):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"import time; time.sleep(300)\""
+        )
+        deadline = time.monotonic() + 10
+        while client.get_job_status(job_id) != JobStatus.RUNNING:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert client.stop_job(job_id)
+        status = client.wait_until_finish(job_id, timeout_s=30)
+        assert status == JobStatus.STOPPED
+
+    def test_list_jobs(self, ray_start_regular):
+        client = JobSubmissionClient()
+        a = client.submit_job(entrypoint="true")
+        b = client.submit_job(entrypoint="true")
+        client.wait_until_finish(a)
+        client.wait_until_finish(b)
+        ids = {j["job_id"] for j in client.list_jobs()}
+        assert {a, b} <= ids
