@@ -1,0 +1,105 @@
+"""Mixture-of-Experts FFN — expert parallelism over the ``expert`` mesh axis.
+
+Absent from the reference entirely (SURVEY §2.4: EP "Absent from Ray
+itself"); TPU-native it is a mesh axis: experts shard onto ``expert``, tokens
+route to their expert via ``all_to_all`` over ICI, compute locally, and route
+back. Static shapes throughout (XLA requirement): per-expert capacity is
+fixed and overflow tokens drop (standard Switch-style capacity factor).
+
+Layout: tokens [B, S, D] → top-1 router → dispatch [E, C, D] (E experts,
+C capacity) → expert FFN → combine back to [B, S, D] weighted by router
+probability. Under ``shard_map`` the E axis is sharded on ``expert`` so each
+device runs only its local experts; the dispatch/combine einsums become
+all_to_all-style collectives compiled by XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layers import gelu
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    def capacity(self, tokens_per_batch: int) -> int:
+        c = int(self.capacity_factor * tokens_per_batch / self.num_experts)
+        return max(4, ((c + 3) // 4) * 4)  # pad to a friendly multiple
+
+
+def init_params(cfg: MoEConfig, key: jax.Array) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    E, D, F = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": (jax.random.normal(k1, (D, E)) * 0.02).astype(cfg.dtype),
+        "w_up": (jax.random.normal(k2, (E, D, F)) * (2.0 / D) ** 0.5).astype(cfg.dtype),
+        "w_down": (jax.random.normal(k3, (E, F, D)) * (2.0 / F) ** 0.5).astype(cfg.dtype),
+    }
+
+
+def logical_axes(cfg: MoEConfig) -> Dict:
+    return {
+        "router": (None, None),
+        "w_up": ("experts", "embed", "mlp"),
+        "w_down": ("experts", "mlp", "embed"),
+    }
+
+
+def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig) -> Tuple[jax.Array, Dict]:
+    """Top-1 (Switch) MoE FFN. x: [B, S, D] → ([B, S, D], aux metrics).
+
+    Pure function of static shapes — safe inside jit/shard_map; the caller
+    shards ``w_up``/``w_down`` on the ``expert`` axis via logical rules.
+    """
+    B, S, D = x.shape
+    E = cfg.num_experts
+    T = B * S
+    C = cfg.capacity(T)
+    flat = x.reshape(T, D)
+
+    logits = jnp.einsum("td,de->te", flat.astype(jnp.float32), params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)          # [T]
+    gate = jnp.max(probs, axis=-1)                   # [T]
+
+    # position of each token within its expert's queue
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)       # [T, E]
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot      # [T, E]
+    pos = jnp.sum(pos_in_expert, axis=-1)                          # [T]
+    keep = pos < C                                                  # overflow drops
+
+    # dispatch tensor [T, E, C] — one-hot of (expert, slot); overflow tokens
+    # map to slot C which is sliced away
+    slot_onehot = jax.nn.one_hot(
+        jnp.where(keep, pos, C), C + 1, dtype=jnp.float32
+    )[:, :C]  # [T, C]
+    dispatch = onehot.astype(jnp.float32)[:, :, None] * slot_onehot[:, None, :]  # [T, E, C]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, flat.astype(jnp.float32))  # [E, C, D]
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(jnp.float32))
+    h = gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, params["w_down"].astype(jnp.float32))  # [E, C, D]
+
+    combine = dispatch * (gate * keep)[:, None, None]               # [T, E, C]
+    y = jnp.einsum("tec,ecd->td", combine, out).astype(x.dtype)     # [T, D]
+
+    # load-balancing auxiliary loss (Switch: E * sum_e f_e * P_e)
+    frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)      # [E]
+    frac_probs = jnp.mean(probs, axis=0)                            # [E]
+    aux_loss = E * jnp.sum(frac_tokens * frac_probs)
+    metrics = {
+        "aux_loss": aux_loss,
+        "dropped_fraction": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+    }
+    return y.reshape(B, S, D), metrics

@@ -57,6 +57,16 @@ class _GroupState:
     def exchange(self, rank: int, value, compute):
         """All ranks deposit, one computes, all withdraw. Returns result."""
         with self.cv:
+            # Phase 0: a fast rank can re-enter for the NEXT collective while
+            # stragglers are still withdrawing from the previous one; without
+            # this drain guard its deposit lands in (and is wiped with) the
+            # old round — mixed-epoch corruption.
+            while self.arrived == self.world_size or rank in self.slots:
+                if not self.cv.wait(timeout=60.0):
+                    raise TimeoutError(
+                        f"collective drain timed out at rank {rank} "
+                        f"(prev round: {self.departed}/{self.world_size} departed)"
+                    )
             epoch = self.epoch
             self.slots[rank] = value
             self.arrived += 1

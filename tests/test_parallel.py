@@ -183,3 +183,41 @@ def test_collectives_send_recv(ray_start_regular):
     r = b.recv_it.remote()
     rt.get(a.send_it.remote())
     np.testing.assert_array_equal(rt.get(r), np.array([7.0, 8.0]))
+
+
+class TestCollectiveRoundStress:
+    def test_back_to_back_allreduce_rounds(self, ray_start_regular):
+        """Regression: a fast rank re-entering round k+1 while a straggler
+        withdraws from round k must not corrupt slots (mixed-epoch race)."""
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.parallel import collectives
+
+        @ray_tpu.remote
+        class Member:
+            def __init__(self, rank, world):
+                from ray_tpu.parallel import collectives as c
+
+                c.init_collective_group(world, rank, group_name="stress")
+                self.rank = rank
+
+            def run_rounds(self, n):
+                from ray_tpu.parallel import collectives as c
+
+                out = []
+                for i in range(n):
+                    # different shape per round: mixing rounds would blow up
+                    shape = (2 + i % 3, 4)
+                    val = np.full(shape, float(self.rank + 1))
+                    out.append(float(c.allreduce(val, group_name="stress").sum()))
+                return out
+
+        world = 3
+        members = [Member.remote(r, world) for r in range(world)]
+        results = ray_tpu.get([m.run_rounds.remote(40) for m in members])
+        assert results[0] == results[1] == results[2]
+        # sum of (1+2+3) over each round's element count
+        expected = [6.0 * ((2 + i % 3) * 4) for i in range(40)]
+        assert results[0] == expected
+        collectives.destroy_collective_group("stress")

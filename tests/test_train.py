@@ -235,3 +235,65 @@ class TestCheckpointRegressions:
         ck.save({"x": object()}, str(tmp_path / "a"))  # unsupported leaf
         with pytest.raises(TypeError):
             ck.wait()
+
+
+class TestGpt2Gate:
+    def test_gpt2_through_jax_trainer_with_data(self, ray_start_regular, storage):
+        """SURVEY §7 P4 gate #2 (scaled down): tiny GPT-2, sharded train step
+        over the virtual mesh, Data-library ingest, checkpointed via report."""
+
+        def loop(config):
+            import tempfile as tf
+
+            import jax
+            import numpy as np
+            import optax
+
+            from ray_tpu import data as rt_data
+            from ray_tpu.models import transformer
+            from ray_tpu.models.training import make_train_step
+            from ray_tpu.parallel.mesh import MeshSpec, cpu_mesh
+            from ray_tpu.parallel.sharding import ShardingRules
+
+            cfg = transformer.tiny(max_seq_len=32, n_layers=2)
+            mesh = cpu_mesh(MeshSpec(data=2, tensor=4))
+            rules = ShardingRules()
+            bundle = make_train_step(
+                loss_fn=lambda p, b: transformer.lm_loss(p, b, cfg, mesh=mesh, rules=rules),
+                init_params_fn=lambda k: transformer.init_params(cfg, k),
+                logical_params=transformer.logical_axes(cfg),
+                mesh=mesh,
+                rules=rules,
+                optimizer=optax.adamw(1e-3),
+                batch_logical=None,
+            )
+            params, opt = bundle.init(jax.random.key(0))
+
+            # token stream through the Data library
+            rng = np.random.default_rng(0)
+            docs = [{"tokens": rng.integers(0, cfg.vocab_size, 32).tolist()} for _ in range(64)]
+            ds = rt_data.from_items(docs)
+            it = ds.iterator()
+
+            losses = []
+            for epoch in range(4):
+                for batch in it.iter_batches(batch_size=8, drop_last=True):
+                    jb = {"tokens": np.stack([np.asarray(t, np.int32) for t in batch["tokens"]])}
+                    params, opt, metrics = bundle.step(params, opt, jb)
+                    losses.append(float(metrics["loss"]))
+                d = tf.mkdtemp()
+                rt_train.save_pytree({"epoch": epoch}, d)
+                rt_train.report(
+                    {"loss": losses[-1], "first_loss": losses[0], "epoch": epoch},
+                    checkpoint=Checkpoint(d),
+                )
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=storage, name="gpt2gate"),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["loss"] < result.metrics["first_loss"]
+        assert rt_train.load_pytree(result.checkpoint.path)["epoch"] == 3
