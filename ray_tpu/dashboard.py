@@ -1,0 +1,135 @@
+"""Dashboard — HTTP observability endpoint.
+
+Analog of the reference's dashboard head (``dashboard/head.py:81`` + feature
+modules; SURVEY §1 L6) scoped to the API layer: JSON state endpoints (the
+state API over HTTP), a Prometheus ``/metrics`` scrape (what the reference's
+metrics agent exports), and a minimal HTML overview. Runs an aiohttp loop in
+a daemon thread like the Serve proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    def start(self) -> "Dashboard":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- server --------------------------------------------------------------
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/cluster_summary", self._json(self._summary))
+        app.router.add_get("/api/nodes", self._json(lambda: _state().list_nodes()))
+        app.router.add_get("/api/actors", self._json(lambda: _state().list_actors()))
+        app.router.add_get("/api/tasks", self._json(lambda: _state().list_tasks()))
+        app.router.add_get("/api/jobs", self._json(lambda: _state().list_jobs()))
+        app.router.add_get(
+            "/api/placement_groups", self._json(lambda: _state().list_placement_groups())
+        )
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/timeline", self._timeline)
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    def _summary(self):
+        return _state().cluster_summary()
+
+    def _json(self, fn):
+        from aiohttp import web
+
+        async def handler(request):
+            loop = asyncio.get_event_loop()
+            data = await loop.run_in_executor(None, fn)
+            return web.Response(
+                text=json.dumps(data, default=str), content_type="application/json"
+            )
+
+        return handler
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import prometheus_text
+
+        return web.Response(text=prometheus_text(), content_type="text/plain")
+
+    async def _timeline(self, request):
+        from aiohttp import web
+
+        import ray_tpu
+
+        loop = asyncio.get_event_loop()
+        trace = await loop.run_in_executor(None, ray_tpu.timeline)
+        return web.Response(text=json.dumps(trace), content_type="application/json")
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        s = await loop.run_in_executor(None, self._summary)
+        rows = "".join(
+            f"<tr><td>{k}</td><td><pre>{json.dumps(v, indent=1, default=str)}</pre></td></tr>"
+            for k, v in s.items()
+        )
+        html = (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            "<h1>ray_tpu cluster</h1><table border=1>"
+            f"{rows}</table>"
+            '<p><a href="/api/cluster_summary">summary</a> · '
+            '<a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> · '
+            '<a href="/api/tasks">tasks</a> · <a href="/metrics">metrics</a> · '
+            '<a href="/timeline">timeline</a></p>'
+            "</body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
+
+
+def _state():
+    from ray_tpu.util import state
+
+    return state
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    return Dashboard(host, port).start()

@@ -1,0 +1,39 @@
+"""Dashboard HTTP endpoint tests (reference: dashboard module tests)."""
+
+import json
+
+import httpx
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture
+def dashboard(ray_start_regular):
+    d = start_dashboard(port=18265)
+    yield d
+    d.stop()
+
+
+class TestDashboard:
+    def test_endpoints(self, dashboard):
+        @ray_tpu.remote
+        def work(x):
+            return x
+
+        ray_tpu.get([work.remote(i) for i in range(3)])
+
+        base = dashboard.url
+        summary = httpx.get(f"{base}/api/cluster_summary", timeout=10).json()
+        assert summary["alive_nodes"] >= 1
+        nodes = httpx.get(f"{base}/api/nodes", timeout=10).json()
+        assert nodes and nodes[0]["state"] == "ALIVE"
+        tasks = httpx.get(f"{base}/api/tasks", timeout=10).json()
+        assert len(tasks) >= 3
+        metrics = httpx.get(f"{base}/metrics", timeout=10)
+        assert metrics.status_code == 200
+        index = httpx.get(base, timeout=10)
+        assert "ray_tpu cluster" in index.text
+        timeline = httpx.get(f"{base}/timeline", timeout=10).json()
+        assert isinstance(timeline, list)
