@@ -41,7 +41,12 @@ def main():
     import os
     attn = os.environ.get("RT_BENCH_ATTN", "dense")
     if on_tpu:
-        cfg = transformer.gpt2_small(max_seq_len=1024, remat=os.environ.get("RT_BENCH_REMAT", "1") == "1", attn_impl=attn)
+        cfg = transformer.gpt2_small(
+            max_seq_len=1024,
+            remat=os.environ.get("RT_BENCH_REMAT", "1") == "1",
+            remat_policy=os.environ.get("RT_BENCH_REMAT_POLICY", "full"),
+            attn_impl=attn,
+        )
         batch_per_chip, seq = int(os.environ.get("RT_BENCH_BATCH", "16")), 1024
         steps, warmup = 20, 3
     else:

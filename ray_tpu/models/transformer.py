@@ -52,6 +52,9 @@ class TransformerConfig:
     tie_embeddings: bool = True
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" | "ulysses"
     remat: bool = False                # jax.checkpoint each block (HBM↔FLOPs)
+    # remat policy: "full" recomputes everything; "dots" saves matmul outputs
+    # and recomputes only cheap elementwise ops (usually faster, more HBM)
+    remat_policy: str = "full"         # "full" | "dots"
     vocab_multiple: int = 128
 
     @property
@@ -259,7 +262,14 @@ def forward(
         h = cstr(h + d, ("batch", "seq_act", None))
         return h, None
 
-    block_fn = jax.checkpoint(block) if c.remat else block
+    if c.remat:
+        if c.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block_fn = jax.checkpoint(block, policy=policy)
+        else:
+            block_fn = jax.checkpoint(block)
+    else:
+        block_fn = block
     h, _ = lax.scan(block_fn, h, params["blocks"])
 
     h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
