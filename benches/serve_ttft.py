@@ -1,0 +1,91 @@
+"""Serve p50 TTFT benchmark (north-star metric #3, BASELINE.json).
+
+A JAX transformer replica served through the full data plane (handle →
+pow-2 router → replica actor), measuring time-to-first-token of a streaming
+generate call. Runs on whatever device is present (real TPU chip under the
+driver; CPU elsewhere).
+
+Prints one JSON line: {"metric": "serve_p50_ttft_ms", ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import transformer
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    cfg = (
+        transformer.gpt2_small(max_seq_len=256)
+        if on_tpu
+        else transformer.tiny(max_seq_len=64)
+    )
+
+    @serve.deployment(max_ongoing_requests=4)
+    class LM:
+        def __init__(self):
+            self.cfg = cfg
+            self.params = transformer.init_params(cfg, jax.random.key(0))
+
+            def step(params, tokens):
+                logits = transformer.forward(params, tokens, cfg)
+                return jnp.argmax(logits[:, -1], axis=-1)
+
+            self._step = jax.jit(step)
+            # warm the cache so TTFT measures serving, not compilation
+            t = jnp.zeros((1, cfg.max_seq_len), jnp.int32)
+            np.asarray(self._step(self.params, t))
+
+        def __call__(self, payload):
+            # greedy generate: fixed-window resample (static shapes)
+            prompt_len = int(payload.get("prompt_len", 16))
+            n_new = int(payload.get("max_new_tokens", 8))
+            tokens = np.zeros((1, self.cfg.max_seq_len), np.int32)
+            tokens[0, :prompt_len] = 1
+            for i in range(n_new):
+                nxt = int(np.asarray(self._step(self.params, jnp.asarray(tokens)))[0])
+                pos = min(prompt_len + i, self.cfg.max_seq_len - 1)
+                tokens[0, pos] = nxt
+                yield {"token": nxt, "index": i}
+
+    ray_tpu.init()
+    handle = serve.run(LM.bind())
+
+    # measure TTFT over sequential requests
+    ttfts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        stream = iter(handle.options(stream=True).remote({"prompt_len": 16, "max_new_tokens": 4}))
+        next(stream)
+        ttfts.append((time.perf_counter() - t0) * 1000)
+        for _ in stream:
+            pass
+    p50 = float(np.percentile(ttfts, 50))
+    p99 = float(np.percentile(ttfts, 99))
+    print(
+        json.dumps(
+            {
+                "metric": "serve_p50_ttft_ms",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "p99_ms": round(p99, 2),
+                "platform": "tpu" if on_tpu else "cpu",
+            }
+        )
+    )
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,8 +38,15 @@ class SingleAgentEnvRunner:
         self.spec = spec or spec_for_env(probe)
         probe.close()
         self.module = RLModule(self.spec)
-        self._params = self.module.init_params(jax.random.key(seed))
-        self._key = jax.random.key(seed + 10_000)
+        # Env-runner inference is tiny and latency-bound: pin it to host CPU
+        # (committed args steer jit placement). The TPU belongs to learners —
+        # shipping a 4-float CartPole obs across the interconnect per step
+        # would make sampling interconnect-latency-bound.
+        self._device = jax.local_devices(backend="cpu")[0]
+        self._params = jax.device_put(
+            self.module.init_params(jax.random.key(seed)), self._device
+        )
+        self._key = jax.device_put(jax.random.key(seed + 10_000), self._device)
         self._sample_fn = jax.jit(self.module.sample_action)
         self._obs, _ = self._envs.reset(seed=seed)
         self._ep_returns = np.zeros(num_envs)
@@ -58,7 +65,9 @@ class SingleAgentEnvRunner:
 
     # -- weights sync (reference: WorkerSet weight broadcast) ----------------
     def set_weights(self, params) -> bool:
-        self._params = jax.tree.map(jnp.asarray, params)
+        self._params = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._device), params
+        )
         return True
 
     def get_weights(self):
@@ -81,7 +90,12 @@ class SingleAgentEnvRunner:
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             obs = np.asarray(self._obs, np.float32).reshape(N, -1)
-            action, logp, value = self._sample_fn(self._params, jnp.asarray(obs), sub)
+            # numpy → CPU device directly: jnp.asarray would materialize on
+            # the DEFAULT device first (a tunnel round trip per env step when
+            # the default device is a remote TPU)
+            action, logp, value = self._sample_fn(
+                self._params, jax.device_put(obs, self._device), sub
+            )
             action_np = np.asarray(action)
             env_action = action_np.astype(np.int64) if self.spec.discrete else action_np
             next_obs, reward, terminated, truncated, _ = self._envs.step(env_action)
@@ -107,7 +121,9 @@ class SingleAgentEnvRunner:
 
         # bootstrap value of the final observation
         last_obs = np.asarray(self._obs, np.float32).reshape(N, -1)
-        out = self.module.forward_inference(self._params, jnp.asarray(last_obs))
+        out = self.module.forward_inference(
+            self._params, jax.device_put(last_obs, self._device)
+        )
         last_val = np.asarray(out["vf_preds"])
 
         return {

@@ -31,12 +31,22 @@ class Learner:
         self.spec = spec
         self.config = dict(config)
         self.module = RLModule(spec)
-        self.params = self.module.init_params(jax.random.key(seed))
+        # device policy: tiny models are latency-bound — run them on host CPU;
+        # big models use the default accelerator. "auto" picks by param count.
+        dev_cfg = self.config.get("device", "auto")
+        n_params = spec.observation_dim * sum(spec.hidden) + spec.hidden[-1] * spec.action_dim
+        if dev_cfg == "cpu" or (dev_cfg == "auto" and n_params < 1_000_000):
+            self.device = jax.local_devices(backend="cpu")[0]
+        else:
+            self.device = jax.devices()[0]
+        self.params = jax.device_put(
+            self.module.init_params(jax.random.key(seed)), self.device
+        )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(self.config.get("grad_clip", 0.5)),
             optax.adam(self.config.get("lr", 3e-4)),
         )
-        self.opt_state = self.optimizer.init(self.params)
+        self.opt_state = jax.device_put(self.optimizer.init(self.params), self.device)
         self._update_fn = jax.jit(self._update)
 
     # -- override point ------------------------------------------------------
@@ -50,7 +60,7 @@ class Learner:
         return params, opt_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        jbatch = {k: jax.device_put(jnp.asarray(v), self.device) for k, v in batch.items()}
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, jbatch
         )
@@ -60,7 +70,9 @@ class Learner:
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, params) -> bool:
-        self.params = jax.tree.map(jnp.asarray, params)
+        self.params = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self.device), params
+        )
         return True
 
     def get_state(self) -> Dict:
