@@ -1,0 +1,169 @@
+"""Autoregressive generation with KV cache.
+
+The inference fast path the Serve replicas use. The reference serves models
+through vLLM/framework engines; TPU-native the decode loop is two jitted XLA
+programs with static shapes:
+
+- ``prefill``: one full forward over the (padded) prompt, writing K/V for
+  every layer into a preallocated cache [L, B, max_len, H, Dh];
+- ``decode_step``: single-token forward reading the cache — O(1) FLOPs in
+  context length per token instead of the O(ctx) full-window forward.
+
+The cache is a pytree carried through ``lax.scan``-style stepping on the
+host; batch/beam layouts stay static so both programs compile exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.ops.layers import gelu, layer_norm, linear, rope
+
+
+def init_cache(config: TransformerConfig, batch: int, max_len: Optional[int] = None) -> Dict:
+    c = config
+    max_len = max_len or c.max_seq_len
+    shape = (c.n_layers, batch, max_len, c.n_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(q, k_cache, v_cache, valid_len, *, scale: float):
+    """q: [B, T, H, D] against cache [B, S, H, D]; positions >= valid_len are
+    masked. For prefill T>1 a causal mask also applies within the window."""
+    B, T, H, D = q.shape
+    S = k_cache.shape[1]
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    kv_pos = jnp.arange(S)[None, None, None, :]          # [1,1,1,S]
+    q_pos = (valid_len - T) + jnp.arange(T)[None, None, :, None]
+    mask = kv_pos <= q_pos                                # causal + validity
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _forward_cached(params, tokens, cache, config: TransformerConfig, start_pos):
+    """Forward ``tokens`` [B, T] at positions [start_pos, start_pos+T),
+    updating the cache. Returns (logits[B, T, V], new_cache)."""
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    B, T = tokens.shape
+    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    positions = start_pos + jnp.arange(T)
+    if c.pos == "learned":
+        h = h + cast(params["pos_embed"])[positions]
+    scale = 1.0 / c.head_dim**0.5
+    valid_len = start_pos + T
+
+    new_k, new_v = [], []
+    for layer in range(c.n_layers):
+        bp = jax.tree.map(lambda p: cast(p[layer]), params["blocks"])
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("btd,dhk->bthk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        k = jnp.einsum("btd,dhk->bthk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        v = jnp.einsum("btd,dhk->bthk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            q = rope(q, positions)
+            k = rope(k, positions)
+        k_cache = lax.dynamic_update_slice(
+            cache["k"][layer], k, (0, start_pos, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            cache["v"][layer], v, (0, start_pos, 0, 0)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        o = _attend_cached(q, k_cache, v_cache, valid_len, scale=scale)
+        o = jnp.einsum("bthk,hkd->btd", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
+        h = h + o
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = gelu(linear(x, bp["w_up"], bp["b_up"]))
+        h = h + linear(u, bp["w_down"], bp["b_down"])
+
+    h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
+    w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", h, cast(w_out), preferred_element_type=jnp.float32)
+    new_cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "length": jnp.asarray(valid_len, jnp.int32),
+    }
+    return logits, new_cache
+
+
+class Generator:
+    """Compiled prefill + decode for one (config, batch, max_len) shape."""
+
+    def __init__(self, params, config: TransformerConfig, *, batch: int = 1,
+                 max_len: Optional[int] = None):
+        self.params = params
+        self.config = config
+        self.batch = batch
+        self.max_len = max_len or config.max_seq_len
+
+        c = config
+
+        @jax.jit
+        def prefill(params, cache, tokens):  # tokens [B, P] (P static)
+            return _forward_cached(params, tokens, cache, c, 0)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode(params, cache, token, pos):  # token [B, 1]
+            logits, cache = _forward_cached(params, token, cache, c, pos)
+            return logits[:, -1], cache
+
+        self._prefill = prefill
+        self._decode = decode
+
+    def generate(
+        self,
+        prompt_tokens,
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+        stream: bool = False,
+    ):
+        """Greedy (temperature=0) or sampled generation. Returns token list
+        (or a generator of tokens when ``stream``)."""
+        import numpy as np
+
+        def run():
+            prompt = jnp.asarray(np.asarray(prompt_tokens, np.int32)).reshape(self.batch, -1)
+            P = prompt.shape[1]
+            cache = init_cache(self.config, self.batch, self.max_len)
+            logits, cache = self._prefill(self.params, cache, prompt)
+            key = jax.random.key(seed)
+            last = logits[:, -1]
+            pos = P
+            for _ in range(max_new_tokens):
+                # mask vocab padding before picking
+                last_real = last[:, : self.config.vocab_size]
+                if temperature > 0:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, last_real / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(last_real, axis=-1)
+                yield int(nxt[0])
+                if pos >= self.max_len:
+                    return
+                last, cache = self._decode(
+                    self.params, cache, nxt[:, None].astype(jnp.int32), pos
+                )
+                pos += 1
+
+        if stream:
+            return run()
+        return list(run())
