@@ -82,9 +82,12 @@ ObjectEntry* alloc_entry(Store* s) {
   return nullptr;
 }
 
-// First-fit allocation from the free list.
+// First-fit allocation from the free list. Minimum allocation is 8 bytes so
+// every object occupies a distinct arena range — zero-size objects would
+// otherwise share an offset with their successor, which breaks crash
+// recovery's entry-table walk (and offset-keyed invariants generally).
 int64_t arena_alloc(Store* s, uint64_t size, uint64_t* out_offset) {
-  size = align8(size);
+  size = align8(size ? size : 1);
   int64_t* prev_link = &s->hdr->free_head;
   int64_t idx = s->hdr->free_head;
   while (idx >= 0) {
@@ -108,7 +111,7 @@ int64_t arena_alloc(Store* s, uint64_t size, uint64_t* out_offset) {
 }
 
 void arena_free(Store* s, uint64_t offset, uint64_t size) {
-  size = align8(size);
+  size = align8(size ? size : 1);  // must mirror arena_alloc's minimum
   s->hdr->bytes_in_use -= size;
   // walk the offset-sorted free list to the insertion point
   int64_t prev = -1;
@@ -152,15 +155,76 @@ void arena_free(Store* s, uint64_t offset, uint64_t size) {
   // free-block table exhausted: leak the space (bounded by table size)
 }
 
+// Rebuild all allocator metadata from the entry table. Used after a peer
+// died mid-mutation (EOWNERDEAD): the free list may be half-spliced, but the
+// entry table is the source of truth — every used entry's [offset, size) is
+// live, everything else in the arena is free. Entries from a death between
+// arena_alloc and `used = 1` are reclaimed (the object was never visible).
+void rebuild_free_list(Store* s) {
+  StoreHeader* h = s->hdr;
+  // Selection-order walk over used entries by offset; O(n^2) but only runs
+  // on the rare crash-recovery path (max_entries is a few thousand).
+  memset(s->free_blocks, 0, sizeof(FreeBlock) * h->max_free_blocks);
+  uint64_t prev_end = 0;
+  uint64_t in_use = 0;
+  uint64_t num_objects = 0;
+  uint64_t last_offset = 0;
+  int64_t last_index = -1;
+  int64_t tail = -1;  // last free block written
+  uint32_t slot = 0;
+  h->free_head = -1;
+  for (;;) {
+    // Next used entry in (offset, table index) order — the index tiebreak
+    // makes the walk robust even if two entries ever shared an offset.
+    ObjectEntry* best = nullptr;
+    int64_t best_index = -1;
+    for (uint32_t i = 0; i < h->max_entries; i++) {
+      ObjectEntry* e = &s->entries[i];
+      if (!e->used) continue;
+      if (e->offset < last_offset ||
+          (e->offset == last_offset && (int64_t)i <= last_index)) {
+        continue;
+      }
+      if (!best || e->offset < best->offset) {
+        best = e;
+        best_index = i;
+      }
+    }
+    uint64_t gap_end = best ? best->offset : h->capacity;
+    if (gap_end > prev_end && slot < h->max_free_blocks) {
+      s->free_blocks[slot] = {prev_end, gap_end - prev_end, -1};
+      if (tail >= 0) {
+        s->free_blocks[tail].next = slot;
+      } else {
+        h->free_head = slot;
+      }
+      tail = slot;
+      slot++;
+    }
+    if (!best) break;
+    last_offset = best->offset;
+    last_index = best_index;
+    uint64_t span = align8(best->size ? best->size : 1);
+    uint64_t end = best->offset + span;
+    if (end > prev_end) prev_end = end;
+    in_use += span;
+    num_objects++;
+  }
+  h->bytes_in_use = in_use;
+  h->num_objects = num_objects;
+}
+
 class Lock {
  public:
   explicit Lock(Store* s) : s_(s) {
     int rc = pthread_mutex_lock(&s_->hdr->mutex);
     if (rc == EOWNERDEAD) {
       // A peer died holding the lock; the robust mutex hands it to us in an
-      // inconsistent state. Mark it consistent so mutual exclusion survives
-      // (store metadata may be mid-update, but every mutation here is
-      // small and idempotent enough that the next ops re-establish it).
+      // inconsistent state. The multi-step free-list splices in
+      // arena_alloc/arena_free are NOT idempotent, so rebuild the allocator
+      // metadata from the entry table (the source of truth) before marking
+      // the mutex consistent.
+      rebuild_free_list(s_);
       pthread_mutex_consistent(&s_->hdr->mutex);
     }
   }
@@ -351,5 +415,26 @@ void rt_store_close(void* handle) {
 }
 
 int rt_store_destroy(const char* name) { return shm_unlink(name); }
+
+// -- test hook (crash-recovery tests) ---------------------------------------
+// Simulates a peer dying mid-splice: acquires the mutex, trashes the
+// allocator metadata, and returns WITHOUT unlocking. The caller then exits,
+// so the next locker observes EOWNERDEAD with inconsistent metadata and must
+// rebuild from the entry table. Compiled ONLY into the test library
+// (libray_tpu_store_test.so) — never exported from the production .so.
+#ifdef RT_STORE_TEST_HOOKS
+int rt_store_test_corrupt_and_hold(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  int rc = pthread_mutex_lock(&s->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    rebuild_free_list(s);
+    pthread_mutex_consistent(&s->hdr->mutex);
+  }
+  s->hdr->free_head = -1;  // dangling: no free space reachable
+  s->hdr->bytes_in_use = s->hdr->capacity;
+  s->hdr->num_objects += 17;
+  return 0;
+}
+#endif  // RT_STORE_TEST_HOOKS
 
 }  // extern "C"

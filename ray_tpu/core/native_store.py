@@ -73,7 +73,11 @@ def _load() -> ctypes.CDLL:
 
 def _pad_id(object_id: bytes) -> bytes:
     if len(object_id) > ID_SIZE:
-        return object_id[:ID_SIZE]
+        # Truncating would alias two ids sharing a 20-byte prefix onto the
+        # same shm slot; callers construct exact 20-byte keys, so reject.
+        raise ValueError(
+            f"object id longer than {ID_SIZE} bytes: {object_id!r}"
+        )
     return object_id.ljust(ID_SIZE, b"\0")
 
 
@@ -145,7 +149,10 @@ class NativeObjectStore:
         if not ptr:
             return None
         buf = (ctypes.c_char * size.value).from_address(ptr)
-        return memoryview(buf).cast("B")
+        # Sealed objects are immutable; hand out read-only views so a
+        # consumer mutating a zero-copy-deserialized array cannot corrupt
+        # the object for other readers (plasma returns read-only buffers).
+        return memoryview(buf).cast("B").toreadonly()
 
     def get_view(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy view whose shm pin auto-releases when the LAST
@@ -159,7 +166,7 @@ class NativeObjectStore:
             return None
         buf = (ctypes.c_char * size.value).from_address(ptr)
         buf._rt_pin = _Pin(self, object_id)  # lifetime-coupled release
-        return memoryview(buf).cast("B")
+        return memoryview(buf).cast("B").toreadonly()
 
     def release(self, object_id: bytes) -> None:
         if not self._handle:

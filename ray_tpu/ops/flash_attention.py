@@ -156,6 +156,11 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     s = scale if scale is not None else 1.0 / d**0.5
     bq = min(block_q, l)
     bk = min(block_k, l)
+    if l % bq != 0 or k.shape[1] % bk != 0:
+        # Odd sequence lengths: take the dense path rather than tracing a
+        # kernel with ragged blocks (padding+masking inside the kernel is a
+        # later optimization; odd L is never the perf-critical case).
+        return _dense_reference(q, k, v, scale=s, causal=causal), (q, k, v)
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out = _flash_forward(
         fold(q), fold(k), fold(v),

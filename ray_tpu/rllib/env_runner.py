@@ -49,6 +49,12 @@ class SingleAgentEnvRunner:
         self._key = jax.device_put(jax.random.key(seed + 10_000), self._device)
         self._sample_fn = jax.jit(self.module.sample_action)
         self._obs, _ = self._envs.reset(seed=seed)
+        # gymnasium >=1.0 vector envs autoreset on the step AFTER done
+        # (NEXT_STEP mode): that step ignores the action and returns the new
+        # episode's reset obs with reward 0.  Transitions recorded on such
+        # steps are junk (action never executed) and must be masked out of
+        # GAE and the loss; this tracks which sub-envs are in that state.
+        self._autoreset = np.zeros(num_envs, dtype=bool)
         self._ep_returns = np.zeros(num_envs)
         self._ep_lens = np.zeros(num_envs, dtype=np.int64)
         self._completed: List[float] = []
@@ -86,6 +92,7 @@ class SingleAgentEnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        valid_buf = np.ones((T, N), np.float32)
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
@@ -107,11 +114,16 @@ class SingleAgentEnvRunner:
             val_buf[t] = np.asarray(value)
             rew_buf[t] = reward
             # GAE must not bootstrap across true terminations; truncations
-            # keep bootstrapping (gymnasium autoreset handles env state).
+            # keep bootstrapping (the obs recorded on the autoreset step is
+            # the truncated episode's FINAL obs, so its value is exactly the
+            # truncation bootstrap — see compute_gae's valids handling).
             done_buf[t] = terminated.astype(np.float32)
+            valid_buf[t] = (~self._autoreset).astype(np.float32)
+            self._autoreset = done.copy()
 
-            self._ep_returns += reward
-            self._ep_lens += 1
+            live = (valid_buf[t] > 0)
+            self._ep_returns += reward * live
+            self._ep_lens += live.astype(np.int64)
             for i in np.nonzero(done)[0]:
                 self._completed.append(float(self._ep_returns[i]))
                 self._completed_lens.append(int(self._ep_lens[i]))
@@ -133,6 +145,7 @@ class SingleAgentEnvRunner:
             "values": val_buf,
             "rewards": rew_buf,
             "terminateds": done_buf,
+            "valids": valid_buf,
             "bootstrap_value": last_val,
         }
 

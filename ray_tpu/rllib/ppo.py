@@ -108,8 +108,19 @@ def compute_gae(
     *,
     gamma: float,
     lambda_: float,
+    valids: np.ndarray | None = None,  # [T, N] 0 on autoreset (junk) steps
 ):
-    """Vectorized GAE (reference: ``rllib/evaluation/postprocessing.py``)."""
+    """Vectorized GAE (reference: ``rllib/evaluation/postprocessing.py``).
+
+    ``valids[t, n] == 0`` marks a gymnasium NEXT_STEP-autoreset transition:
+    the action was ignored by the env and ``obs[t]`` is the *final* obs of
+    the episode that just ended.  Zeroing ``last`` there (a) cuts the GAE
+    trace so nothing leaks across the episode boundary, and (b) leaves
+    ``next_value = values[t] = V(final obs)`` for the preceding step — which
+    is exactly the truncation bootstrap the reference computes from
+    ``final_observation`` (``rllib/evaluation/postprocessing.py``).
+    Terminated boundaries are handled by ``nonterminal`` as usual.
+    """
     T, N = rewards.shape
     adv = np.zeros((T, N), np.float32)
     last = np.zeros(N, np.float32)
@@ -118,6 +129,8 @@ def compute_gae(
         nonterminal = 1.0 - terminateds[t]
         delta = rewards[t] + gamma * next_value * nonterminal - values[t]
         last = delta + gamma * lambda_ * nonterminal * last
+        if valids is not None:
+            last = last * valids[t]
         adv[t] = last
         next_value = values[t]
     targets = adv + values
@@ -204,17 +217,27 @@ class PPO:
 
         # 2. advantages per runner, then concat to a flat train batch
         obs_l, act_l, logp_l, adv_l, tgt_l = [], [], [], [], []
+        sampled_steps = 0
         for s in samples:
+            valids = s.get("valids")
             adv, tgt = compute_gae(
                 s["rewards"], s["values"], s["terminateds"], s["bootstrap_value"],
-                gamma=cfg.gamma, lambda_=cfg.lambda_,
+                gamma=cfg.gamma, lambda_=cfg.lambda_, valids=valids,
             )
             T, N = s["rewards"].shape
-            obs_l.append(s["obs"].reshape(T * N, -1))
-            act_l.append(s["actions"].reshape(T * N, *s["actions"].shape[2:]))
-            logp_l.append(s["logp"].reshape(T * N))
-            adv_l.append(adv.reshape(T * N))
-            tgt_l.append(tgt.reshape(T * N))
+            sampled_steps += T * N
+            # Drop autoreset (junk) transitions entirely — their action was
+            # never executed, so they carry no training signal.
+            keep = (
+                valids.reshape(T * N) > 0
+                if valids is not None
+                else np.ones(T * N, bool)
+            )
+            obs_l.append(s["obs"].reshape(T * N, -1)[keep])
+            act_l.append(s["actions"].reshape(T * N, *s["actions"].shape[2:])[keep])
+            logp_l.append(s["logp"].reshape(T * N)[keep])
+            adv_l.append(adv.reshape(T * N)[keep])
+            tgt_l.append(tgt.reshape(T * N)[keep])
         batch = {
             "obs": np.concatenate(obs_l),
             "actions": np.concatenate(act_l),
@@ -226,7 +249,9 @@ class PPO:
         adv = batch["advantages"]
         batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         rows = len(batch["obs"])
-        self._timesteps += rows
+        # timesteps = env steps sampled (autoreset steps included — they
+        # occupy a sample slot even though their transition is dropped).
+        self._timesteps += sampled_steps
 
         # 3. SGD epochs over minibatches
         rng = np.random.default_rng(cfg.seed + self._iteration)
@@ -251,7 +276,7 @@ class PPO:
             "timesteps_total": self._timesteps,
             "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
             "loss": float(np.mean(losses)) if losses else float("nan"),
-            "env_steps_per_sec": rows / t_total,
+            "env_steps_per_sec": sampled_steps / t_total,
             "time_sample_s": t_sample,
             "time_total_s": t_total,
         }

@@ -107,3 +107,91 @@ print("CHILD-OK")
         view = store.get(b"reply")
         assert bytes(view) == b"from-child"
         store.release(b"reply")
+
+    def test_views_are_readonly(self, store):
+        """Sealed objects are immutable: zero-copy views must be read-only so
+        a consumer can't corrupt the object for other readers (plasma
+        returns read-only buffers for sealed objects)."""
+        arr = np.arange(16, dtype=np.int64)
+        store.put(b"ro", arr.tobytes())
+        view = store.get(b"ro")
+        assert view.readonly
+        back = np.frombuffer(view, np.int64)
+        assert not back.flags.writeable
+        with pytest.raises((TypeError, ValueError)):
+            view[0] = 0xFF
+        store.release(b"ro")
+        view2 = store.get_view(b"ro")
+        assert view2.readonly
+
+    def test_long_id_rejected(self, store):
+        """Ids longer than ID_SIZE must raise, not silently truncate (two
+        ids sharing a 20-byte prefix would alias the same shm slot)."""
+        with pytest.raises(ValueError):
+            store.put(b"x" * 21, b"data")
+        with pytest.raises(ValueError):
+            store.get(b"y" * 40)
+
+    def test_eownerdead_rebuilds_allocator(self, store):
+        """A peer that dies holding the robust mutex with half-spliced
+        allocator metadata: the next locker must rebuild the free list from
+        the entry table (the source of truth), not just mark the mutex
+        consistent."""
+        import ctypes
+
+        # The corrupt-and-hold hook is only exported from the test build.
+        native_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "ray_tpu", "_native",
+        )
+        subprocess.run(
+            ["make", "-C", native_dir, "test"],
+            check=True, capture_output=True, timeout=120,
+        )
+        test_lib = os.path.join(native_dir, "libray_tpu_store_test.so")
+
+        payload = np.arange(2048, dtype=np.int64)
+        # zero-size object: must occupy a distinct arena range (min alloc)
+        # so recovery's offset walk can never conflate it with a neighbor
+        store.put(b"empty", b"")
+        store.put(b"survivor", payload.tobytes())
+        in_use_before = store.bytes_in_use()
+        num_before = store.num_objects()
+
+        code = f"""
+import sys, ctypes, os
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+# Open the segment entirely through the TEST build of the library (same
+# source, plus the crash-injection hook; Store struct layout is identical).
+lib = ctypes.CDLL({test_lib!r})
+lib.rt_store_open.restype = ctypes.c_void_p
+lib.rt_store_open.argtypes = [ctypes.c_char_p]
+lib.rt_store_test_corrupt_and_hold.restype = ctypes.c_int
+lib.rt_store_test_corrupt_and_hold.argtypes = [ctypes.c_void_p]
+h = lib.rt_store_open({store.name!r}.encode())
+assert h, "open failed"
+lib.rt_store_test_corrupt_and_hold(h)
+print("CORRUPTED", flush=True)
+os._exit(1)  # die holding the lock -> EOWNERDEAD for the next locker
+"""
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert "CORRUPTED" in out.stdout, out.stderr
+
+        # Next op takes the EOWNERDEAD path and rebuilds; invariants restored.
+        assert store.contains(b"survivor")
+        assert store.bytes_in_use() == in_use_before
+        assert store.num_objects() == num_before
+        view = store.get(b"survivor")
+        np.testing.assert_array_equal(np.frombuffer(view, np.int64), payload)
+        store.release(b"survivor")
+        # allocator still functional: can fill a fresh object without
+        # overwriting survivors (the zero-size entry kept its own range)
+        store.put(b"after", b"z" * 4096)
+        assert store.contains(b"after")
+        assert store.contains(b"empty")
+        view2 = store.get(b"survivor")
+        np.testing.assert_array_equal(np.frombuffer(view2, np.int64), payload)
+        store.release(b"survivor")

@@ -85,7 +85,76 @@ class TestGAE:
         assert adv[0, 0] == pytest.approx(1.0)
 
 
+
+    def test_autoreset_step_cut_and_bootstrap(self):
+        """gymnasium NEXT_STEP autoreset: the step after a done is a junk
+        transition (action ignored, reward 0, obs = final obs of the old
+        episode). valids must (a) zero its advantage, (b) cut the GAE trace
+        so the new episode's deltas don't leak backward, and (c) leave
+        V(final obs) as the truncation bootstrap for the preceding step."""
+        gamma, lam = 0.9, 0.95
+        # t=0: last real step of ep A (truncated); t=1: junk autoreset step
+        # whose value is V(final obs of A); t=2: first real step of ep B.
+        rewards = np.array([[1.0], [0.0], [2.0]], np.float32)
+        values = np.array([[0.5], [0.7], [0.3]], np.float32)
+        terms = np.zeros((3, 1), np.float32)
+        valids = np.array([[1.0], [0.0], [1.0]], np.float32)
+        boot = np.array([0.4], np.float32)
+        adv, tgt = compute_gae(
+            rewards, values, terms, boot, gamma=gamma, lambda_=lam, valids=valids
+        )
+        # t=2 (new episode): plain one-step + bootstrap
+        d2 = 2.0 + gamma * 0.4 - 0.3
+        assert adv[2, 0] == pytest.approx(d2, rel=1e-5)
+        # t=1 (junk): advantage zeroed
+        assert adv[1, 0] == 0.0
+        # t=0 (truncated): bootstraps with V(final obs)=values[1], and the
+        # trace does NOT include d2 (no cross-episode leak)
+        d0 = 1.0 + gamma * 0.7 - 0.5
+        assert adv[0, 0] == pytest.approx(d0, rel=1e-5)
+
+    def test_no_valids_matches_legacy(self):
+        rewards = np.ones((4, 2), np.float32)
+        values = np.full((4, 2), 0.3, np.float32)
+        terms = np.zeros((4, 2), np.float32)
+        boot = np.full(2, 0.3, np.float32)
+        a1, t1 = compute_gae(rewards, values, terms, boot, gamma=0.9, lambda_=0.9)
+        a2, t2 = compute_gae(
+            rewards, values, terms, boot, gamma=0.9, lambda_=0.9,
+            valids=np.ones((4, 2), np.float32),
+        )
+        np.testing.assert_allclose(a1, a2)
+        np.testing.assert_allclose(t1, t2)
+
+
 class TestEnvRunner:
+
+    def test_valids_mark_autoreset_steps(self):
+        """The step AFTER each done must be flagged invalid (gymnasium
+        NEXT_STEP autoreset: that step's action is ignored by the env)."""
+        import gymnasium as gym
+
+        def short_ep():
+            return gym.make("CartPole-v1", max_episode_steps=4)
+
+        r = SingleAgentEnvRunner(short_ep, num_envs=2, seed=0)
+        batch = r.sample(12)
+        valids = batch["valids"]
+        rewards = batch["rewards"]
+        assert valids.shape == (12, 2)
+        # every invalid step has reward 0 (env ignored the action)
+        assert np.all(rewards[valids == 0.0] == 0.0)
+        # episodes cap at 4 steps -> dones occur -> some autoreset steps
+        assert (valids == 0.0).sum() >= 2
+        # an invalid step is always immediately preceded by a done step:
+        # valid transitions and nonzero reward at t-1
+        T, N = valids.shape
+        for t in range(1, T):
+            for n in range(N):
+                if valids[t, n] == 0.0:
+                    assert valids[t - 1, n] == 1.0  # never two junk in a row
+        r.stop()
+
     def test_sample_shapes_and_metrics(self):
         r = SingleAgentEnvRunner(cartpole, num_envs=3, seed=0)
         batch = r.sample(20)
