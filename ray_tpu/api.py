@@ -22,6 +22,7 @@ from ray_tpu.core.runtime import get_runtime, init_runtime, shutdown_runtime
 
 def init(
     *,
+    address: str | None = None,
     resources: Dict[str, float] | None = None,
     num_cpus: float | None = None,
     num_tpus: float | None = None,
@@ -31,11 +32,36 @@ def init(
     labels: Dict[str, str] | None = None,
     ignore_reinit_error: bool = True,
 ):
-    """Start the runtime (head node + N virtual nodes in-process)."""
+    """Start the runtime.
+
+    Without ``address``: head node + N virtual nodes in-process (the fast
+    single-process runtime). With ``address="host:port"``: connect this
+    process as a driver to a running multiprocess cluster's GCS (the
+    ``ray.init(address=...)`` path — see ``ray_tpu.core.cluster``).
+    """
     if _runtime_mod._global_runtime is not None:
         if ignore_reinit_error:
             return _runtime_mod._global_runtime
         raise RuntimeError("ray_tpu.init() already called")
+    if address is not None:
+        # Cluster shape is fixed by the running daemons; reject options that
+        # would silently be ignored (the reference raises on this misuse too).
+        ignored = {
+            "resources": resources, "num_cpus": num_cpus,
+            "num_tpus": num_tpus, "labels": labels,
+            "system_config": system_config,
+        }
+        bad = [k for k, v in ignored.items() if v is not None]
+        if num_nodes != 1:
+            bad.append("num_nodes")
+        if bad:
+            raise ValueError(
+                f"init(address=...) connects to an existing cluster; "
+                f"{bad} cannot apply (configure the daemons instead)"
+            )
+        from ray_tpu.core.cluster import connect
+
+        return connect(address, namespace=namespace)
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)

@@ -1,0 +1,783 @@
+"""Core worker — the client runtime inside every driver and worker process.
+
+Analog of the reference's ``CoreWorker`` (``src/ray/core_worker/
+core_worker.h:291``): owns task submission (lease from the control plane,
+push to the node daemon — the role of ``transport/direct_task_transport.cc``),
+actor submission (direct RPC to the actor's worker process —
+``transport/direct_actor_task_submitter.cc``), the object API (local value
+cache = the in-process memory store; the node's shm arena = plasma provider;
+remote fetch through node daemons = pull manager), reference counting with
+owner-side frees (``reference_count.h:61``), and retries
+(``task_manager.cc``).
+
+One instance per process, installed as the global runtime so the same
+``ray_tpu.api`` surface (and nested ``f.remote()`` calls inside tasks) work
+identically in drivers and workers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    TaskCancelledError,
+    TaskError,
+    WorkerDiedError,
+)
+from ray_tpu.core.gcs import ActorInfo, NodeInfo
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import RpcClient, RpcClientPool, RpcConnectionError
+from ray_tpu.core.task_spec import TaskSpec, TaskType
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("core_worker")
+
+
+class _GcsClientAdapter:
+    """Duck-types the in-process ``Runtime.gcs`` surface over RPC.
+
+    The reference's equivalent is the GCS client (``gcs_client.h``) used by
+    every worker; the function-table half caches deserialized callables locally
+    exactly as ``function_manager.py`` does.
+    """
+
+    def __init__(self, client: RpcClient):
+        self._client = client
+        self._fn_cache: Dict[str, Any] = {}
+        self._fn_lock = threading.Lock()
+
+    # -- functions ------------------------------------------------------------
+
+    def export_function(self, function_id: str, payload: Any) -> None:
+        with self._fn_lock:
+            self._fn_cache[function_id] = payload
+        self._client.call("export_function", function_id,
+                          serialization.dumps(payload))
+
+    def get_function(self, function_id: str) -> Any:
+        with self._fn_lock:
+            if function_id in self._fn_cache:
+                return self._fn_cache[function_id]
+        blob = self._client.call("get_function", function_id)
+        if blob is None:
+            return None
+        fn = serialization.loads(blob)
+        with self._fn_lock:
+            self._fn_cache[function_id] = fn
+        return fn
+
+    # -- actors ---------------------------------------------------------------
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        return self._client.call("get_named_actor", name, namespace)
+
+    def list_named_actors(self, namespace=None):
+        return self._client.call("list_named_actors", namespace)
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        info = self._client.call("get_actor_info", actor_id)
+        if info is None:
+            return None
+        out = ActorInfo(actor_id=actor_id, name=info["name"],
+                        class_name=info["class_name"], state=info["state"],
+                        node_id=info["node_id"],
+                        num_restarts=info["num_restarts"],
+                        death_cause=info["death_cause"])
+        return out
+
+    # -- nodes ----------------------------------------------------------------
+
+    @property
+    def nodes(self) -> Dict[NodeID, NodeInfo]:
+        out = {}
+        for n in self._client.call("list_nodes"):
+            out[n["node_id"]] = NodeInfo(
+                node_id=n["node_id"], address=n["address"],
+                resources=n["resources"], labels=n["labels"],
+                alive=n["alive"],
+            )
+        return out
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._client.call("cluster_resources")
+
+    # -- KV -------------------------------------------------------------------
+
+    def kv_put(self, key, value, namespace="default", overwrite=True):
+        return self._client.call("kv_put", key, value, namespace, overwrite)
+
+    def kv_get(self, key, namespace="default"):
+        return self._client.call("kv_get", key, namespace)
+
+    def kv_del(self, key, namespace="default"):
+        return self._client.call("kv_del", key, namespace)
+
+    def kv_keys(self, prefix="", namespace="default"):
+        return self._client.call("kv_keys", prefix, namespace)
+
+    # -- observability --------------------------------------------------------
+
+    def record_task_event(self, event: dict) -> None:
+        try:
+            self._client.notify("record_task_event", event)
+        except RpcConnectionError:
+            pass
+
+    def task_events(self) -> List[dict]:
+        return self._client.call("task_events")
+
+
+class _SchedulerProxy:
+    def __init__(self, client: RpcClient):
+        self._client = client
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._client.call("available_resources")
+
+
+class _LocalRefCounter:
+    """Process-local reference counting; owner frees cluster-wide on zero.
+
+    Simplified from ``reference_count.h:61``: each process counts its own
+    Python handles + in-flight submitted-task borrows; only the *owner*
+    (creating process) triggers a cluster-wide free, so non-owner processes
+    dropping their copies can never delete an object they borrowed.
+    """
+
+    def __init__(self, core: "CoreWorker"):
+        self._core = core
+        self._lock = threading.Lock()
+        self._local: Dict[ObjectID, int] = {}
+        self._submitted: Dict[ObjectID, int] = {}
+        self._owned: set = set()
+
+    def set_owned(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._owned.add(object_id)
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._local[object_id] = self._local.get(object_id, 0) + 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        self._dec(self._local, object_id)
+
+    def add_submitted_task_reference(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._submitted[object_id] = self._submitted.get(object_id, 0) + 1
+
+    def remove_submitted_task_reference(self, object_id: ObjectID) -> None:
+        self._dec(self._submitted, object_id)
+
+    def _dec(self, table: Dict[ObjectID, int], object_id: ObjectID) -> None:
+        free = False
+        with self._lock:
+            n = table.get(object_id, 0) - 1
+            if n > 0:
+                table[object_id] = n
+            else:
+                table.pop(object_id, None)
+            if (object_id in self._owned
+                    and not self._local.get(object_id)
+                    and not self._submitted.get(object_id)):
+                self._owned.discard(object_id)
+                free = True
+        if free:
+            self._core._free_object(object_id)
+
+
+class _PendingTask:
+    __slots__ = ("refs", "done", "error")
+
+    def __init__(self, refs: List[ObjectID]):
+        self.refs = refs
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+class CoreWorker:
+    """The per-process runtime client (driver or worker mode)."""
+
+    def __init__(self, gcs_address: str, *,
+                 node_id: NodeID | None = None,
+                 node_address: str | None = None,
+                 store_name: str = "",
+                 job_id: JobID | None = None,
+                 namespace: str = "default",
+                 mode: str = "driver"):
+        self.gcs_address = gcs_address
+        self.mode = mode
+        self.namespace = namespace
+        self._gcs_rpc = RpcClient(gcs_address)
+        self.gcs = _GcsClientAdapter(self._gcs_rpc)
+        self.scheduler = _SchedulerProxy(self._gcs_rpc)
+        self.reference_counter = _LocalRefCounter(self)
+        self._daemons = RpcClientPool()
+        self._actor_clients = RpcClientPool()
+
+        # Local node binding (for puts + zero-copy shm gets). Nodes may be
+        # mid-(re)registration — e.g. a driver attaching right after a GCS
+        # restart — so poll briefly before giving up.
+        if node_id is None:
+            deadline = time.time() + 15.0
+            while True:
+                nodes = self._gcs_rpc.call("list_nodes")
+                alive = [n for n in nodes if n["alive"]]
+                if alive:
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError("no alive nodes in cluster")
+                time.sleep(0.2)
+            node_id = alive[0]["node_id"]
+            node_address = alive[0]["address"]
+            store_name = alive[0]["labels"].get("_object_store", "")
+        self.current_node_id = node_id
+        self._node_address = node_address
+        self._local_daemon = self._daemons.get(node_address)
+        self._shm = None
+        if store_name:
+            try:
+                from ray_tpu.core.native_store import NativeObjectStore
+
+                self._shm = NativeObjectStore.open(store_name)
+            except Exception:  # noqa: BLE001 — daemon RPC path still works
+                logger.debug("cannot open shm store %r; using daemon fetch",
+                             store_name)
+
+        self.job_id = job_id or self._gcs_rpc.call("next_job_id")
+        if mode == "driver":
+            import os
+
+            self._gcs_rpc.notify("add_job", self.job_id, "driver", os.getpid())
+
+        # Object value cache (the in-process memory store of the reference).
+        self._cache: Dict[ObjectID, Any] = {}
+        self._cache_lock = threading.Lock()
+        self._cache_cv = threading.Condition(self._cache_lock)
+        self._pending: Dict[ObjectID, _PendingTask] = {}
+
+        # Task submission machinery.
+        self._submit_pool = ThreadPoolExecutor(max_workers=128,
+                                               thread_name_prefix="submit")
+        self._actor_addr_cache: Dict[ActorID, str] = {}
+        self._actor_queues: Dict[tuple, dict] = {}
+        self._generators: Dict[TaskID, List[ObjectID]] = {}
+
+        # Execution context (worker mode fills these per task).
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._shutdown = False
+
+    # ====================== objects ======================
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.for_put()
+        self._seal_object(oid, value)
+        self.reference_counter.set_owned(oid)
+        return ObjectRef(oid)
+
+    def _seal_object(self, oid: ObjectID, value, lineage: bytes | None = None) -> None:
+        """Store locally + make fetchable cluster-wide."""
+        with self._cache_cv:
+            self._cache[oid] = value
+            self._cache_cv.notify_all()
+        payload = serialization.dumps(value)
+        if (self._shm is not None
+                and len(payload) >= config().native_store_threshold):
+            # Zero-copy plane: write the bytes into the node's shm arena
+            # directly (same-node readers map them without a copy), then
+            # register the location.
+            try:
+                from ray_tpu.core.node_daemon import NodeDaemon
+
+                self._shm.put(NodeDaemon._shm_key(oid.binary()), payload)
+                self._gcs_rpc.notify("add_object_location", oid.binary(),
+                                     self.current_node_id, len(payload), lineage)
+                return
+            except Exception:  # noqa: BLE001 — arena full → daemon heap
+                pass
+        try:
+            self._local_daemon.notify("put_object", oid.binary(), payload, lineage)
+        except RpcConnectionError:
+            logger.warning("local daemon unreachable; object %s is cache-only",
+                           oid.hex()[:12])
+
+    def _free_object(self, oid: ObjectID) -> None:
+        with self._cache_lock:
+            self._cache.pop(oid, None)
+        try:
+            self._gcs_rpc.notify("free_object", oid.binary())
+        except RpcConnectionError:
+            pass
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        deadline = time.time() + timeout if timeout is not None else None
+        values = []
+        for r in ref_list:
+            value = self._get_one(r, deadline)
+            if isinstance(value, TaskError):
+                raise value.as_instanceof_cause()
+            if isinstance(value, (TaskCancelledError, ActorError)):
+                raise value
+            values.append(value)
+        return values[0] if single else values
+
+    def _get_one(self, ref: ObjectRef, deadline: float | None):
+        oid = ref.id
+        backoff = 0.001
+        while True:
+            with self._cache_lock:
+                if oid in self._cache:
+                    return self._cache[oid]
+                pending = self._pending.get(oid)
+            if pending is not None:
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+                pending.done.wait(timeout=remaining if remaining is not None
+                                  else 1.0)
+                with self._cache_lock:
+                    if oid in self._cache:
+                        return self._cache[oid]
+                if pending.done.is_set():
+                    # Completed but not cached here (e.g. ref from another
+                    # process path) — fall through to the fetch path.
+                    pass
+            value = self._try_fetch(oid)
+            if value is not _MISSING:
+                with self._cache_cv:
+                    self._cache[oid] = value
+                    self._cache_cv.notify_all()
+                return value
+            if deadline is not None and time.time() >= deadline:
+                raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
+
+    def _try_fetch(self, oid: ObjectID):
+        """Local shm → local daemon → remote daemons (pull manager path)."""
+        key_bytes = oid.binary()
+        if self._shm is not None:
+            from ray_tpu.core.node_daemon import NodeDaemon
+
+            key = NodeDaemon._shm_key(key_bytes)
+            view = self._shm.get(key)
+            if view is not None:
+                try:
+                    return serialization.loads(view)
+                finally:
+                    self._shm.release(key)
+        try:
+            locations = self._gcs_rpc.call("locate_object", key_bytes)
+        except RpcConnectionError:
+            return _MISSING
+        for node_id, addr, _size in locations:
+            try:
+                payload = self._daemons.get(addr).call(
+                    "fetch_object", key_bytes, timeout=60.0
+                )
+            except (RpcConnectionError, TimeoutError):
+                continue
+            if payload is not None:
+                return serialization.loads(payload)
+        return _MISSING
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None, fetch_local: bool = True):
+        refs = list(refs)
+        deadline = time.time() + timeout if timeout is not None else None
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref.id):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready(self, oid: ObjectID) -> bool:
+        with self._cache_lock:
+            if oid in self._cache:
+                return True
+            p = self._pending.get(oid)
+        if p is not None:
+            return p.done.is_set()
+        if self._shm is not None:
+            from ray_tpu.core.node_daemon import NodeDaemon
+
+            if self._shm.contains(NodeDaemon._shm_key(oid.binary())):
+                return True
+        try:
+            return bool(self._gcs_rpc.call("locate_object", oid.binary()))
+        except RpcConnectionError:
+            return False
+
+    def future_for(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def asyncio_future_for(self, ref: ObjectRef, loop):
+        afut = loop.create_future()
+
+        def run():
+            try:
+                value = self.get(ref)
+                loop.call_soon_threadsafe(afut.set_result, value)
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(afut.set_exception, e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return afut
+
+    # ====================== tasks ======================
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        n = spec.options.num_returns
+        num = n if isinstance(n, int) else 0
+        return_ids = spec.return_object_ids(num)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        for oid in return_ids:
+            self.reference_counter.set_owned(oid)
+        for dep in spec.dependencies():
+            self.reference_counter.add_submitted_task_reference(dep)
+        pending = _PendingTask(return_ids)
+        with self._cache_lock:
+            for oid in return_ids:
+                self._pending[oid] = pending
+            if not isinstance(n, int):
+                self._pending_dynamic = getattr(self, "_pending_dynamic", {})
+        self._submit_pool.submit(self._run_submission, spec, pending)
+        return refs
+
+    def _run_submission(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        """Lease → push → (maybe retry) → record results. One thread per
+        in-flight task, mirroring the async submit loop of
+        ``direct_task_transport.cc`` with retries from ``task_manager.cc``."""
+        try:
+            self._run_submission_inner(spec, pending)
+        except BaseException as exc:  # noqa: BLE001 — a swallowed submission
+            # exception would leave the pending task unresolved forever.
+            logger.exception("task submission for %s failed", spec.function_name)
+            self._record_task_error(
+                spec, pending,
+                TaskError.from_exception(spec.function_name, exc))
+
+    def _run_submission_inner(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        spec_bytes = serialization.dumps(spec)
+        resources = dict(spec.options.resources)
+        if spec.task_type == TaskType.NORMAL_TASK and "CPU" not in resources:
+            resources["CPU"] = 1.0
+        max_retries = spec.options.max_retries
+        attempt = 0
+        try:
+            while True:
+                attempt += 1
+                try:
+                    lease_id, node_id, node_addr = self._gcs_rpc.call(
+                        "request_lease", resources,
+                        spec.options.scheduling_strategy, timeout=None,
+                    )
+                except RpcConnectionError as e:
+                    self._record_task_error(
+                        spec, pending,
+                        TaskError(spec.function_name,
+                                  f"GCS unreachable: {e}", None))
+                    return
+                try:
+                    result = self._daemons.get(node_addr).call(
+                        "execute_task", spec_bytes, lease_id, timeout=None
+                    )
+                except Exception as e:  # noqa: BLE001
+                    retriable = isinstance(e, RpcConnectionError) or (
+                        isinstance(e, WorkerDiedError) and e.retriable
+                    )
+                    if retriable and attempt <= max_retries:
+                        logger.info("task %s attempt %d failed (%s); retrying",
+                                    spec.function_name, attempt, e)
+                        # Backoff so the node's reaper collects dead workers
+                        # before we lease again (retry pacing, task_manager.cc).
+                        time.sleep(min(0.2 * attempt, 2.0))
+                        continue
+                    self._record_task_error(
+                        spec, pending,
+                        TaskError(spec.function_name,
+                                  f"{type(e).__name__}: {e}", None))
+                    return
+                if result.get("ok"):
+                    self._record_task_results(spec, pending, result)
+                    return
+                # Application error inside the task.
+                error = serialization.loads(result["error"])
+                retry_exc = spec.options.retry_exceptions
+                should_retry = bool(retry_exc) and attempt <= max_retries
+                if should_retry and isinstance(retry_exc, (list, tuple)):
+                    cause_type = result.get("error_type", "")
+                    should_retry = any(
+                        t.__name__ == cause_type for t in retry_exc
+                    )
+                if should_retry:
+                    continue
+                self._record_task_error(spec, pending, error)
+                return
+        finally:
+            for dep in spec.dependencies():
+                self.reference_counter.remove_submitted_task_reference(dep)
+
+    def _record_task_results(self, spec: TaskSpec, pending: _PendingTask,
+                             result: dict) -> None:
+        returns: List[Tuple[bytes, Optional[bytes]]] = result["returns"]
+        with self._cache_cv:
+            for oid_bytes, inline in returns:
+                if inline is not None:
+                    self._cache[ObjectID(oid_bytes)] = serialization.loads(inline)
+            for oid in pending.refs:
+                self._pending.pop(oid, None)
+            if result.get("generator_items") is not None:
+                self._generators[spec.task_id] = [
+                    ObjectID(b) for b in result["generator_items"]
+                ]
+            self._cache_cv.notify_all()
+        pending.done.set()
+
+    def _record_task_error(self, spec: TaskSpec, pending: _PendingTask,
+                           error) -> None:
+        with self._cache_cv:
+            for oid in pending.refs:
+                self._cache[oid] = error
+                self._pending.pop(oid, None)
+            if spec.task_id not in self._generators:
+                self._generators[spec.task_id] = []
+            self._cache_cv.notify_all()
+        pending.error = error
+        pending.done.set()
+
+    # ====================== actors ======================
+
+    def create_actor(self, spec: TaskSpec) -> ActorID:
+        spec_bytes = serialization.dumps(spec)
+        return self._gcs_rpc.call("create_actor", spec_bytes)
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        n = spec.options.num_returns
+        num = n if isinstance(n, int) else 0
+        return_ids = spec.return_object_ids(num)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        for oid in return_ids:
+            self.reference_counter.set_owned(oid)
+        pending = _PendingTask(return_ids)
+        with self._cache_lock:
+            for oid in return_ids:
+                self._pending[oid] = pending
+        self._enqueue_actor_call(spec, pending)
+        return refs
+
+    def _enqueue_actor_call(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        """Per-(actor, handle) ordered dispatch.
+
+        Calls from one handle go out strictly in sequence-number order, one
+        at a time — the client half of the reference's
+        ``sequential_actor_submit_queue.cc`` contract. Serial dispatch also
+        makes restarts safe: a fresh incarnation always hears this handle's
+        oldest outstanding call first (see worker_main._admit_in_order).
+        """
+        key = (spec.actor_id, spec.caller_id)
+        with self._cache_lock:
+            queue = self._actor_queues.get(key)
+            if queue is None:
+                queue = {"heap": [], "running": False}
+                self._actor_queues[key] = queue
+            import heapq
+
+            heapq.heappush(queue["heap"], (spec.sequence_number, spec, pending))
+            if queue["running"]:
+                return
+            queue["running"] = True
+        self._submit_pool.submit(self._drain_actor_queue, key, queue)
+
+    def _drain_actor_queue(self, key, queue) -> None:
+        import heapq
+
+        while True:
+            with self._cache_lock:
+                if not queue["heap"]:
+                    queue["running"] = False
+                    return
+                _seq, spec, pending = heapq.heappop(queue["heap"])
+            try:
+                self._run_actor_submission(spec, pending)
+            except BaseException as exc:  # noqa: BLE001 — keep draining
+                logger.exception("actor submission failed")
+                self._record_task_error(
+                    spec, pending,
+                    TaskError.from_exception(
+                        f"{spec.function_name}.{spec.actor_method}", exc))
+
+    def _actor_address(self, actor_id: ActorID, timeout: float = 120.0) -> str:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr is not None:
+            return addr
+        info = self._gcs_rpc.call("wait_actor_alive", actor_id,
+                                  timeout=timeout)
+        addr = info["address"]
+        self._actor_addr_cache[actor_id] = addr
+        return addr
+
+    def _run_actor_submission(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        """Direct actor transport with restart-transparent redirection.
+
+        On connection loss the call is retried against the actor's *next*
+        incarnation: the failed address is quarantined and we poll the GCS
+        actor table until the address changes (the daemon's death report or
+        the GCS health check drives the restart), mirroring the reference's
+        client resubmit-to-new-address path (gcs pubsub of actor state +
+        ``direct_actor_task_submitter``'s pending queue flush on reconnect).
+        Raises ActorDiedError once the restart ladder is exhausted.
+        """
+        spec_bytes = serialization.dumps(spec)
+        failed_addrs: set = set()
+        deadline = time.time() + 300.0
+        while True:
+            try:
+                addr = self._actor_address(spec.actor_id)
+            except Exception as e:  # noqa: BLE001 — actor dead / timeout
+                self._record_task_error(
+                    spec, pending,
+                    ActorDiedError(spec.actor_id.hex(),
+                                   f"actor unavailable: {e}"))
+                return
+            if addr in failed_addrs:
+                # Stale table entry (the control plane hasn't noticed the
+                # death yet). Wait for the address to change or the actor
+                # to die rather than hammering a corpse.
+                if time.time() > deadline:
+                    self._record_task_error(
+                        spec, pending,
+                        ActorDiedError(spec.actor_id.hex(),
+                                       "actor stuck on a dead worker"))
+                    return
+                self._actor_addr_cache.pop(spec.actor_id, None)
+                time.sleep(0.2)
+                continue
+            try:
+                result = self._actor_clients.get(addr).call(
+                    "run_actor_task", spec_bytes, timeout=None
+                )
+            except RpcConnectionError:
+                failed_addrs.add(addr)
+                self._actor_addr_cache.pop(spec.actor_id, None)
+                self._actor_clients.invalidate(addr)
+                continue
+            if result.get("ok"):
+                self._record_task_results(spec, pending, result)
+            else:
+                self._record_task_error(
+                    spec, pending, serialization.loads(result["error"]))
+            return
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._actor_addr_cache.pop(actor_id, None)
+        self._gcs_rpc.call("kill_actor", actor_id, no_restart)
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        """Best-effort cancel: only not-yet-completed tasks are affected."""
+        with self._cache_lock:
+            pending = self._pending.get(ref.id)
+        if pending is not None and not pending.done.is_set():
+            error = TaskCancelledError(ref.id.task_id())
+            with self._cache_cv:
+                for oid in pending.refs:
+                    if oid not in self._cache:
+                        self._cache[oid] = error
+                self._cache_cv.notify_all()
+
+    # ====================== generators ======================
+
+    def next_generator_item(self, task_id: TaskID, index: int):
+        deadline = time.time() + 300.0
+        while True:
+            with self._cache_lock:
+                items = self._generators.get(task_id)
+            if items is not None:
+                if index >= len(items):
+                    return None
+                return ObjectRef(items[index])
+            if time.time() > deadline:
+                raise GetTimeoutError(f"generator {task_id.hex()[:12]} timed out")
+            time.sleep(0.005)
+
+    async def next_generator_item_async(self, task_id: TaskID, index: int):
+        import asyncio
+
+        while True:
+            with self._cache_lock:
+                items = self._generators.get(task_id)
+            if items is not None:
+                if index >= len(items):
+                    return None
+                return ObjectRef(items[index])
+            await asyncio.sleep(0.005)
+
+    # ====================== placement groups ======================
+
+    def create_placement_group(self, pg_id, bundles, strategy, name="",
+                               timeout: float = 60.0) -> bool:
+        return self._gcs_rpc.call("create_placement_group", pg_id, name,
+                                  bundles, strategy, timeout, timeout=None)
+
+    def remove_placement_group(self, pg_id) -> None:
+        self._gcs_rpc.call("remove_placement_group", pg_id)
+
+    def get_placement_group(self, pg_id) -> Optional[dict]:
+        return self._gcs_rpc.call("get_placement_group", pg_id)
+
+    # ====================== lifecycle ======================
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self.mode == "driver":
+            try:
+                self._gcs_rpc.notify("finish_job", self.job_id)
+            except RpcConnectionError:
+                pass
+        self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        self._daemons.close_all()
+        self._actor_clients.close_all()
+        self._gcs_rpc.close()
+        if self._shm is not None:
+            self._shm.close()
+
+
+_MISSING = object()

@@ -107,5 +107,23 @@ class OutOfMemoryError(RayTpuError):
     """Object store is full and eviction/spilling could not make room."""
 
 
+class WorkerDiedError(RayTpuError):
+    """A worker process exited while running a task (retriable).
+
+    Raised by the node daemon's ``execute_task`` when its worker's RPC
+    connection drops mid-task (reference: worker failure reported by the
+    raylet to the owner, which retries per ``max_retries`` —
+    ``task_manager.cc``). Lives here (not in the daemon module) so it
+    unpickles in every process regardless of ``python -m`` aliasing.
+    """
+
+    def __init__(self, message: str, retriable: bool = True):
+        super().__init__(message)
+        self.retriable = retriable
+
+    def __reduce__(self):
+        return (WorkerDiedError, (self.args[0], self.retriable))
+
+
 class PendingCallsLimitExceededError(RayTpuError):
     """Actor's max_pending_calls was exceeded."""

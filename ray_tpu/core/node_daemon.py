@@ -1,0 +1,453 @@
+"""Node daemon — one process per node: worker pool + object plane host.
+
+Analog of the reference's raylet (``src/ray/raylet/main.cc:37-96`` daemon
+contract, ``node_manager.cc``): registers the node with the GCS, heartbeats,
+spawns and reaps **worker processes** (the ``WorkerPool`` of
+``src/ray/raylet/worker_pool.cc`` — ``PopWorker`` decl ``worker_pool.h:343``),
+forwards leased tasks to workers, hosts the node's shared-memory object store
+(the plasma store runs inside the raylet in the reference,
+``object_manager.cc:32-40``), and serves object fetches to remote nodes (the
+push/pull transfer half of ``src/ray/object_manager/``).
+
+Scheduling itself lives in the GCS (centralized resource truth); the daemon
+is the execution plane: lease arrives → pop worker → push task → reply.
+
+Runs standalone::
+
+    python -m ray_tpu.core.node_daemon --gcs HOST:PORT [--resources JSON]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import Config, config, set_config
+from ray_tpu.core.ids import ActorID, NodeID, WorkerID
+from ray_tpu.core.rpc import (
+    RpcClient,
+    RpcClientPool,
+    RpcConnectionError,
+    RpcServer,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("node_daemon")
+
+
+from ray_tpu.core.exceptions import WorkerDiedError
+
+
+class _Worker:
+    __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy")
+
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address: Optional[str] = None
+        self.client: Optional[RpcClient] = None
+        self.actor_id: Optional[ActorID] = None  # dedicated to an actor
+        self.busy = False
+
+
+class NodeDaemon:
+    """RPC surface called by the GCS (actor starts) and by core workers
+    (task pushes, object puts/fetches)."""
+
+    def __init__(self, gcs_address: str, resources: Dict[str, float],
+                 labels: Dict[str, str] | None = None,
+                 host: str = "127.0.0.1"):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self._gcs = RpcClient(gcs_address)
+        self._peers = RpcClientPool()
+        cfg = config()
+
+        # --- object plane: C++ shm arena + heap shelf for small objects ----
+        self.store_name = f"raytpu-{self.node_id.hex()[:12]}"
+        self._shm = None
+        try:
+            from ray_tpu.core.native_store import NativeObjectStore
+
+            self._shm = NativeObjectStore(
+                self.store_name, capacity=cfg.object_store_memory
+            )
+        except Exception as e:  # noqa: BLE001 — heap fallback keeps tests green
+            logger.warning("native shm store unavailable (%s); heap fallback", e)
+            self.store_name = ""
+        self._heap: Dict[bytes, bytes] = {}
+        self._heap_lock = threading.Lock()
+
+        # --- worker pool ----------------------------------------------------
+        self._pool_lock = threading.Lock()
+        self._pool_cv = threading.Condition(self._pool_lock)
+        self._workers: Dict[WorkerID, _Worker] = {}
+        self._idle: List[_Worker] = []
+        self._spawn_pending = 0  # spawned but not yet registered
+        self._demand = 0  # _pop_worker calls currently waiting
+        num_cpus = resources.get("CPU", os.cpu_count() or 4)
+        self._max_workers = max(int(num_cpus) * 2, cfg.max_workers_per_node)
+
+        self._server = RpcServer(self, host=host, name="raylet")
+        self.address = self._server.address
+        self._resources = resources
+        self._labels = labels or {}
+        # Live actor records for GCS-restart re-adoption:
+        # actor_id -> (spec_bytes, worker_addr)
+        self._actor_records: Dict[ActorID, Tuple[bytes, str]] = {}
+
+        reply = self._gcs.call(
+            "register_node", self.node_id, self.address, resources,
+            self._labels, self.store_name,
+        )
+        # Adopt the cluster's config so flags set at head apply node-wide
+        # (the reference plumbs _system_config through raylet gflags).
+        set_config(Config(reply.get("config")))
+
+        self._stopped = threading.Event()
+        threading.Thread(target=self._heartbeat_loop, name="daemon-heartbeat",
+                         daemon=True).start()
+        threading.Thread(target=self._reaper_loop, name="daemon-reaper",
+                         daemon=True).start()
+
+    # ====================== heartbeat / lifecycle ======================
+
+    def _heartbeat_loop(self) -> None:
+        period = config().health_check_period_s / 2.0
+        while not self._stopped.wait(period):
+            try:
+                status = self._gcs.call("heartbeat", self.node_id, timeout=5.0)
+            except (RpcConnectionError, TimeoutError):
+                logger.warning("heartbeat to GCS failed")
+                continue
+            if status == "dead" or status is False:
+                logger.error("GCS declared this node dead; exiting")
+                self.shutdown()
+                os._exit(1)
+            if status == "unknown":
+                # Fresh GCS (head restart): re-register with our live actor
+                # records so the new control plane re-adopts them
+                # (raylet reconnect-with-backoff, gcs_init_data rebuild).
+                logger.info("GCS does not know this node; re-registering")
+                with self._pool_lock:
+                    hosted = [(aid, rec[0], rec[1])
+                              for aid, rec in self._actor_records.items()]
+                try:
+                    self._gcs.call(
+                        "register_node", self.node_id, self.address,
+                        self._resources, self._labels, self.store_name,
+                        hosted_actors=hosted, timeout=10.0,
+                    )
+                except (RpcConnectionError, TimeoutError):
+                    logger.warning("re-register failed; will retry")
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        with self._pool_lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        if self._shm is not None:
+            try:
+                self._shm.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        self._server.stop()
+
+    # ====================== worker pool ======================
+
+    def _spawn_worker(self) -> _Worker:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_DAEMON_ADDRESS"] = self.address
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_STORE_NAME"] = self.store_name
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+        )
+        worker = _Worker(worker_id, proc)
+        self._workers[worker_id] = worker
+        return worker
+
+    def register_worker(self, worker_id: WorkerID, address: str) -> None:
+        """Called by a freshly started worker process once its server is up."""
+        with self._pool_cv:
+            self._spawn_pending = max(0, self._spawn_pending - 1)
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                return
+            worker.address = address
+            worker.client = RpcClient(address)
+            self._idle.append(worker)
+            self._pool_cv.notify_all()
+
+    def _pop_worker(self, timeout: float = 60.0) -> _Worker:
+        """PopWorker (worker_pool.h:343): reuse an idle worker or spawn.
+
+        Spawn accounting: start new processes only up to the number of
+        waiting pops not already covered by in-flight spawns (the
+        reference's maximum_startup_concurrency bound in worker_pool.cc).
+        """
+        deadline = time.time() + timeout
+        with self._pool_cv:
+            self._demand += 1
+            try:
+                while True:
+                    while self._idle:
+                        worker = self._idle.pop()
+                        if worker.proc.poll() is None:
+                            worker.busy = True
+                            return worker
+                    live = sum(1 for w in self._workers.values()
+                               if w.proc.poll() is None)
+                    if (live + self._spawn_pending < self._max_workers
+                            and self._spawn_pending < self._demand):
+                        self._spawn_worker()
+                        self._spawn_pending += 1
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError("no worker available")
+                    self._pool_cv.wait(timeout=min(remaining, 1.0))
+            finally:
+                self._demand -= 1
+
+    def _return_worker(self, worker: _Worker) -> None:
+        with self._pool_cv:
+            if (worker.proc.poll() is None and worker.actor_id is None
+                    and worker.worker_id in self._workers):
+                worker.busy = False
+                self._idle.append(worker)
+                self._pool_cv.notify_all()
+
+    def _reaper_loop(self) -> None:
+        """Detect worker deaths (the raylet learns via child SIGCHLD)."""
+        while not self._stopped.wait(0.1):
+            dead: List[_Worker] = []
+            with self._pool_cv:
+                for worker in list(self._workers.values()):
+                    if worker.proc.poll() is not None:
+                        dead.append(worker)
+                        self._workers.pop(worker.worker_id, None)
+                        if worker in self._idle:
+                            self._idle.remove(worker)
+                        if worker.address is None:
+                            # Died before registering: un-account the spawn.
+                            self._spawn_pending = max(0, self._spawn_pending - 1)
+                if dead:
+                    self._pool_cv.notify_all()
+            for worker in dead:
+                rc = worker.proc.returncode
+                if worker.actor_id is not None:
+                    with self._pool_lock:
+                        self._actor_records.pop(worker.actor_id, None)
+                    cause = (f"worker process for actor "
+                             f"{worker.actor_id.hex()[:8]} exited rc={rc}")
+                    logger.warning(cause)
+                    try:
+                        self._gcs.call("report_actor_failure",
+                                       worker.actor_id, cause, timeout=10.0)
+                    except (RpcConnectionError, TimeoutError):
+                        pass
+                if worker.client is not None:
+                    worker.client.close()
+
+    # ====================== task execution ======================
+
+    def execute_task(self, spec_bytes: bytes, lease_id: str) -> dict:
+        """Run one task on a pooled worker; returns the worker's result meta.
+
+        The reference pushes tasks from the *driver* straight to the leased
+        worker (``direct_task_transport.cc:241 PushNormalTask``); we route
+        through the daemon so worker identity stays private to the node and
+        worker death maps cleanly to a retriable error for the caller.
+        """
+        try:
+            worker = self._pop_worker()
+        except TimeoutError as e:
+            self._release(lease_id)
+            raise WorkerDiedError(f"worker pool exhausted: {e}") from e
+        broken = False
+        try:
+            result = worker.client.call("run_task", spec_bytes, timeout=None)
+            return result
+        except RpcConnectionError as e:
+            broken = True
+            raise WorkerDiedError(
+                f"worker died while running task: {e}"
+            ) from e
+        finally:
+            self._release(lease_id)
+            if broken:
+                # Never return a worker whose channel broke: its process is
+                # dead or wedged. Kill it so the reaper collects it instead
+                # of handing the same corpse to the next pop.
+                try:
+                    worker.proc.kill()
+                except OSError:
+                    pass
+            else:
+                self._return_worker(worker)
+
+    def _release(self, lease_id: str) -> None:
+        try:
+            self._gcs.notify("release_lease", lease_id)
+        except RpcConnectionError:
+            pass
+
+    # ====================== actors ======================
+
+    def start_actor(self, spec_bytes: bytes, lease_id: str) -> str:
+        """Dedicate a worker process to an actor; returns the worker address.
+
+        The lease is held for the actor's lifetime (its resources stay
+        allocated), released when the worker dies or the actor is killed.
+        """
+        worker = self._pop_worker()
+        try:
+            worker.client.call("start_actor", spec_bytes, timeout=None)
+        except RpcConnectionError as e:
+            self._release(lease_id)
+            try:
+                worker.proc.kill()
+            except OSError:
+                pass
+            raise WorkerDiedError(f"worker died during actor init: {e}") from e
+        except Exception:
+            self._release(lease_id)
+            self._return_worker(worker)
+            raise
+        from ray_tpu.core import serialization
+
+        spec = serialization.loads(spec_bytes)
+        with self._pool_lock:
+            worker.actor_id = spec.actor_id
+            self._actor_records[spec.actor_id] = (spec_bytes, worker.address)
+        return worker.address
+
+    def kill_actor_worker(self, actor_id: ActorID) -> bool:
+        with self._pool_lock:
+            target = next((w for w in self._workers.values()
+                           if w.actor_id == actor_id), None)
+            if target is not None:
+                # Forget the actor binding so the reaper doesn't report this
+                # intentional kill as a failure needing restart.
+                target.actor_id = None
+                self._actor_records.pop(actor_id, None)
+        if target is None:
+            return False
+        try:
+            target.proc.kill()
+        except OSError:
+            pass
+        return True
+
+    # ====================== object plane ======================
+
+    def put_object(self, object_id: bytes, payload: bytes,
+                   lineage: bytes | None = None) -> None:
+        """Seal an object into this node's store and register its location."""
+        self._store_local(object_id, payload)
+        self._gcs.notify("add_object_location", object_id, self.node_id,
+                         len(payload), lineage)
+
+    def _store_local(self, object_id: bytes, payload: bytes) -> None:
+        stored = False
+        if self._shm is not None and len(payload) >= config().native_store_threshold:
+            try:
+                self._shm.put(self._shm_key(object_id), payload)
+                stored = True
+            except Exception:  # noqa: BLE001 — arena full → heap
+                logger.exception("shm put failed; using heap")
+        if not stored:
+            with self._heap_lock:
+                self._heap[object_id] = bytes(payload)
+
+    def fetch_object(self, object_id: bytes) -> Optional[bytes]:
+        """Serve an object's bytes (node-to-node transfer pull path)."""
+        if self._shm is not None:
+            view = self._shm.get(self._shm_key(object_id))
+            if view is not None:
+                try:
+                    return bytes(view)
+                finally:
+                    self._shm.release(self._shm_key(object_id))
+        with self._heap_lock:
+            return self._heap.get(object_id)
+
+    def has_object(self, object_id: bytes) -> bool:
+        if self._shm is not None and self._shm.contains(self._shm_key(object_id)):
+            return True
+        with self._heap_lock:
+            return object_id in self._heap
+
+    def free_object(self, object_id: bytes) -> None:
+        if self._shm is not None:
+            self._shm.delete(self._shm_key(object_id))
+        with self._heap_lock:
+            self._heap.pop(object_id, None)
+
+    @staticmethod
+    def _shm_key(object_id: bytes) -> bytes:
+        # ObjectID is 28 bytes; the native arena keys are 20. Use the task-id
+        # tail + return index — unique because the task-id tail is random.
+        return object_id[-20:]
+
+    def stats(self) -> dict:
+        with self._pool_lock:
+            n_workers = len(self._workers)
+            n_idle = len(self._idle)
+        return {
+            "node_id": self.node_id,
+            "workers": n_workers,
+            "idle": n_idle,
+            "shm_bytes": self._shm.bytes_in_use() if self._shm else 0,
+            "heap_objects": len(self._heap),
+        }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args(argv)
+    resources = json.loads(args.resources)
+    if "CPU" not in resources:
+        resources["CPU"] = float(os.cpu_count() or 4)
+    daemon = NodeDaemon(args.gcs, resources, json.loads(args.labels),
+                        host=args.host)
+    print(f"NODE_ADDRESS={daemon.address}", flush=True)
+    print(f"NODE_ID={daemon.node_id.hex()}", flush=True)
+    print(f"STORE_NAME={daemon.store_name}", flush=True)
+
+    stop = threading.Event()
+
+    def handle(sig, frame):
+        daemon.shutdown()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    stop.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

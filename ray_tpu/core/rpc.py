@@ -1,0 +1,346 @@
+"""Socket RPC — the wire layer between cluster processes.
+
+TPU-era analog of the reference's gRPC plumbing (``src/ray/rpc/`` — typed
+client/server wrappers with retrying clients; service methods declared in
+``src/ray/protobuf/*.proto``). We use length-prefixed frames over TCP with
+cloudpickle payloads instead of protobuf/HTTP2: the control plane carries
+small metadata messages (task specs, leases, table updates), while bulk data
+rides the shared-memory object plane (``_native/object_store.cc``) or XLA
+collectives — so the RPC layer optimizes for simplicity and correct failure
+propagation, not throughput.
+
+Wire format, one frame per message::
+
+    8-byte big-endian length | payload = pickle((kind, request_id, method, data))
+
+``kind`` is ``"req"`` / ``"rep"`` / ``"err"`` / ``"note"`` (one-way).
+Requests multiplex over one connection: each carries a request id and replies
+may arrive out of order (the reference gets this from HTTP/2 streams; we get
+it from a reader thread matching ids to futures).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("rpc")
+
+_LEN = struct.Struct(">Q")
+# Hard cap on a single frame (control messages are small; sealed objects can
+# be fetched in one frame — match the reference's practical object sizes).
+MAX_FRAME = 16 * 1024 * 1024 * 1024
+
+
+class RpcError(Exception):
+    """Base for transport-level failures."""
+
+
+class RpcConnectionError(RpcError, ConnectionError):
+    """Peer unreachable / connection dropped with requests in flight."""
+
+
+class RpcRemoteError(RpcError):
+    """Handler raised; carries the remote traceback string."""
+
+    def __init__(self, exc: BaseException, remote_traceback: str):
+        super().__init__(f"{type(exc).__name__}: {exc}\n{remote_traceback}")
+        self.cause = exc
+        self.remote_traceback = remote_traceback
+
+
+def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 4 * 1024 * 1024))
+        if not chunk:
+            raise RpcConnectionError("connection closed by peer")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _dumps(message: Tuple) -> bytes:
+    import cloudpickle
+
+    try:
+        return pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        return cloudpickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class RpcServer:
+    """Threaded RPC server dispatching to a handler object's public methods.
+
+    The reference declares services in .proto and generates servers per
+    service (``src/ray/rpc/gcs_server/``, ``node_manager/``, ``worker/``);
+    here any object is a service — its public methods are the RPC surface.
+    Handlers run on a shared pool so slow calls (task execution, long-poll
+    subscriptions) don't block the accept or read loops.
+    """
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 64, name: str = "rpc"):
+        self._handler = handler
+        self._name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix=f"{name}-h")
+        self._stopped = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._conn_loop, args=(conn,),
+                name=f"{self._name}-conn", daemon=True,
+            ).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                kind, req_id, method, data = _recv_frame(conn)
+                if kind == "note":
+                    self._pool.submit(self._run_note, method, data)
+                elif kind == "req":
+                    self._pool.submit(
+                        self._run_request, conn, send_lock, req_id, method, data
+                    )
+        except (RpcConnectionError, OSError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _run_note(self, method: str, data: Tuple) -> None:
+        try:
+            args, kwargs = data
+            getattr(self._handler, method)(*args, **kwargs)
+        except Exception:
+            logger.exception("%s: notification %s failed", self._name, method)
+
+    def _run_request(self, conn, send_lock, req_id, method, data) -> None:
+        try:
+            args, kwargs = data
+            fn = getattr(self._handler, method, None)
+            if fn is None or method.startswith("_"):
+                raise AttributeError(f"no RPC method '{method}'")
+            result = fn(*args, **kwargs)
+            frame = _dumps(("rep", req_id, method, result))
+        except BaseException as exc:  # noqa: BLE001 — propagate to caller
+            tb = traceback.format_exc()
+            try:
+                frame = _dumps(("err", req_id, method, (exc, tb)))
+            except Exception:
+                # Unpicklable exception: degrade to a plain RuntimeError.
+                frame = _dumps(
+                    ("err", req_id, method,
+                     (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
+                )
+        try:
+            _send_frame(conn, frame, send_lock)
+        except OSError:
+            pass  # caller is gone; nothing to do
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            for conn in list(self._conns):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class RpcClient:
+    """Thread-safe client with multiplexed in-flight requests.
+
+    Mirrors the reference's retryable gRPC client (``src/ray/rpc/
+    retryable_grpc_client.h``) minimally: one TCP connection, a reader thread
+    resolving futures by request id; connection loss fails every in-flight
+    call with :class:`RpcConnectionError` (callers own retry policy, exactly
+    as core-worker transports do in the reference).
+    """
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        self.address = address
+        self._timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._closed = False
+
+    # -- connection management ------------------------------------------------
+
+    def _ensure_connected(self) -> socket.socket:
+        with self._state_lock:
+            if self._closed:
+                raise RpcConnectionError("client closed")
+            if self._sock is not None:
+                return self._sock
+            host, port = self.address.rsplit(":", 1)
+            try:
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=self._timeout)
+            except OSError as e:
+                raise RpcConnectionError(
+                    f"cannot connect to {self.address}: {e}"
+                ) from e
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+            threading.Thread(
+                target=self._read_loop, args=(sock,),
+                name=f"rpc-read-{self.address}", daemon=True,
+            ).start()
+            return sock
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                kind, req_id, _method, data = _recv_frame(sock)
+                with self._state_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    continue
+                if kind == "rep":
+                    fut.set_result(data)
+                else:
+                    exc, tb = data
+                    fut.set_exception(RpcRemoteError(exc, tb))
+        except BaseException as e:  # noqa: BLE001 — any reader death must
+            # fail in-flight calls, else callers hang forever (e.g. an
+            # AttributeError unpickling a class the peer defined in __main__).
+            self._fail_all(RpcConnectionError(f"connection to {self.address} lost: {e}"))
+
+    def _fail_all(self, error: Exception) -> None:
+        with self._state_lock:
+            pending, self._pending = self._pending, {}
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(error)
+
+    # -- calls ------------------------------------------------------------------
+
+    def call_async(self, method: str, *args, **kwargs) -> Future:
+        sock = self._ensure_connected()
+        with self._state_lock:
+            req_id = self._next_id
+            self._next_id += 1
+            fut: Future = Future()
+            self._pending[req_id] = fut
+        frame = _dumps(("req", req_id, method, (args, kwargs)))
+        try:
+            _send_frame(sock, frame, self._send_lock)
+        except OSError as e:
+            self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
+        return fut
+
+    def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        fut = self.call_async(method, *args, **kwargs)
+        try:
+            return fut.result(timeout=timeout)
+        except RpcRemoteError as e:
+            # Re-raise the original exception type when it round-tripped, so
+            # callers catch domain errors (ValueError, TaskError...) natively.
+            raise e.cause from e
+
+    def notify(self, method: str, *args, **kwargs) -> None:
+        sock = self._ensure_connected()
+        frame = _dumps(("note", 0, method, (args, kwargs)))
+        try:
+            _send_frame(sock, frame, self._send_lock)
+        except OSError as e:
+            self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
+            raise RpcConnectionError(str(e)) from e
+
+    def close(self) -> None:
+        with self._state_lock:
+            self._closed = True
+        self._fail_all(RpcConnectionError("client closed"))
+
+    def __repr__(self):
+        return f"RpcClient({self.address})"
+
+
+class RpcClientPool:
+    """Cached clients keyed by address (reference: client pools in
+    ``src/ray/rpc/*_client_pool.h``)."""
+
+    def __init__(self, connect_timeout: float = 10.0):
+        self._timeout = connect_timeout
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None:
+                client = RpcClient(address, connect_timeout=self._timeout)
+                self._clients[address] = client
+            return client
+
+    def invalidate(self, address: str) -> None:
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()

@@ -1,0 +1,326 @@
+"""Worker process — executes tasks and hosts actors.
+
+Analog of the reference's worker process
+(``python/ray/_private/workers/default_worker.py`` bootstrap; task execution
+callback ``_raylet.pyx:2246 task_execution_handler``; server-side actor
+scheduling queues ``transport/actor_scheduling_queue.cc`` with per-caller
+sequence ordering from ``sequential_actor_submit_queue.cc`` and concurrency
+control from ``concurrency_group_manager.cc``).
+
+Spawned by the node daemon with identity/addresses in env vars; registers its
+RPC server back with the daemon, installs a :class:`CoreWorker` as the global
+runtime (so nested ``f.remote()``/``get``/``put`` inside user code work), and
+serves ``run_task`` / ``start_actor`` / ``run_actor_task``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.core_worker import CoreWorker
+from ray_tpu.core.exceptions import ActorError, TaskCancelledError, TaskError
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("worker")
+
+
+class _DependencyFailed(Exception):
+    def __init__(self, error):
+        self.error = error
+
+
+class _ActorState:
+    """A resident actor instance + its scheduling queue state."""
+
+    def __init__(self, actor_id: ActorID, instance: Any, max_concurrency: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.next_seq: Dict[str, int] = {}  # caller_id -> next expected seq
+        self.slots = threading.Semaphore(max(1, max_concurrency))
+        self.serial = max_concurrency <= 1
+        self.loop: Optional[asyncio.AbstractEventLoop] = None  # async actors
+
+    def ensure_loop(self) -> asyncio.AbstractEventLoop:
+        with self.lock:
+            if self.loop is None:
+                loop = asyncio.new_event_loop()
+                threading.Thread(target=loop.run_forever,
+                                 name=f"actor-loop-{self.actor_id.hex()[:8]}",
+                                 daemon=True).start()
+                self.loop = loop
+            return self.loop
+
+
+class WorkerService:
+    """RPC surface pushed to by the daemon (tasks) and callers (actor tasks)."""
+
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._actors_lock = threading.Lock()
+
+    # ====================== normal tasks ======================
+
+    def run_task(self, spec_bytes: bytes) -> dict:
+        spec: TaskSpec = serialization.loads(spec_bytes)
+        self.core.current_task_id = spec.task_id
+        try:
+            fn = self.core.gcs.get_function(spec.function_id)
+            if fn is None:
+                raise RuntimeError(f"function {spec.function_id} not in GCS")
+            args, kwargs = self._resolve_args(spec)
+            result = fn(*args, **kwargs)
+            return self._package_results(spec, result)
+        except _DependencyFailed as df:
+            return self._package_error(spec, df.error)
+        except BaseException as exc:  # noqa: BLE001 — wire to the caller
+            return self._package_error(
+                spec, TaskError.from_exception(spec.function_name, exc))
+        finally:
+            self.core.current_task_id = None
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        def resolve(arg):
+            if arg.is_ref:
+                value = self.core._get_one(ObjectRef(arg.object_id), None)
+                if isinstance(value, (TaskError, TaskCancelledError, ActorError)):
+                    raise _DependencyFailed(value)
+                return value
+            return arg.value
+
+        args = [resolve(a) for a in spec.args]
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _package_results(self, spec: TaskSpec, result) -> dict:
+        n = spec.options.num_returns
+        if n in ("dynamic", "streaming"):
+            items: List[bytes] = []
+            for i, item in enumerate(result):
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                self._seal_return(oid, item)
+                items.append(oid.binary())
+            return {"ok": True, "returns": [], "generator_items": items}
+        if n == 0:
+            return {"ok": True, "returns": []}
+        values = (result,) if n == 1 else tuple(result)
+        if n > 1 and len(values) != n:
+            raise ValueError(
+                f"task {spec.function_name} declared num_returns={n} but "
+                f"returned {len(values)} values"
+            )
+        returns = []
+        inline_cap = config().max_inline_object_size
+        for i, value in enumerate(values):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            payload = self._seal_return(oid, value)
+            inline = payload if len(payload) <= inline_cap else None
+            returns.append((oid.binary(), inline))
+        return {"ok": True, "returns": returns}
+
+    def _seal_return(self, oid: ObjectID, value) -> bytes:
+        """Seal a return object so any process can fetch it; returns payload.
+
+        Small returns also ride inline in the reply (the reference's
+        ``max_direct_call_object_size`` path, ray_config_def.h:206); they are
+        still sealed node-side so borrowers on other nodes can pull them.
+        """
+        payload = serialization.dumps(value)
+        core = self.core
+        if (core._shm is not None
+                and len(payload) >= config().native_store_threshold):
+            from ray_tpu.core.node_daemon import NodeDaemon
+
+            try:
+                core._shm.put(NodeDaemon._shm_key(oid.binary()), payload)
+                core._gcs_rpc.notify("add_object_location", oid.binary(),
+                                     core.current_node_id, len(payload), None)
+                return payload
+            except Exception:  # noqa: BLE001 — arena full → daemon heap
+                pass
+        try:
+            core._local_daemon.notify("put_object", oid.binary(), payload, None)
+        except RpcConnectionError:
+            logger.warning("daemon unreachable sealing %s", oid.hex()[:12])
+        return payload
+
+    def _package_error(self, spec: TaskSpec, error) -> dict:
+        error_bytes = serialization.dumps(error)
+        # Seal the error under every return id so dependent tasks (arg refs)
+        # fail with the propagated error, matching in-process semantics.
+        n = spec.options.num_returns
+        num = n if isinstance(n, int) else 1
+        for i in range(max(num, 1)):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            try:
+                self.core._local_daemon.notify("put_object", oid.binary(),
+                                               error_bytes, None)
+            except RpcConnectionError:
+                pass
+        cause_type = ""
+        if isinstance(error, TaskError) and error.cause is not None:
+            cause_type = type(error.cause).__name__
+        return {"ok": False, "error": error_bytes, "error_type": cause_type}
+
+    # ====================== actors ======================
+
+    def start_actor(self, spec_bytes: bytes) -> bool:
+        spec: TaskSpec = serialization.loads(spec_bytes)
+        cls = self.core.gcs.get_function(spec.function_id)
+        if cls is None:
+            raise RuntimeError(f"actor class {spec.function_id} not in GCS")
+        args, kwargs = self._resolve_args(spec)
+        self.core.current_actor_id = spec.actor_id
+        instance = cls(*args, **kwargs)
+        state = _ActorState(spec.actor_id, instance,
+                            spec.options.max_concurrency)
+        with self._actors_lock:
+            self._actors[spec.actor_id] = state
+        logger.info("actor %s (%s) started in pid %d",
+                    spec.actor_id.hex()[:8], spec.function_name, os.getpid())
+        return True
+
+    def run_actor_task(self, spec_bytes: bytes) -> dict:
+        spec: TaskSpec = serialization.loads(spec_bytes)
+        with self._actors_lock:
+            state = self._actors.get(spec.actor_id)
+        if state is None:
+            return self._package_error(
+                spec, ActorError(spec.actor_id.hex(),
+                                 "actor not hosted by this worker"))
+        self._admit_in_order(state, spec)
+        try:
+            method = getattr(state.instance, spec.actor_method, None)
+            if method is None:
+                raise AttributeError(
+                    f"actor {spec.function_name} has no method "
+                    f"'{spec.actor_method}'")
+            args, kwargs = self._resolve_args(spec)
+            if inspect.iscoroutinefunction(method):
+                loop = state.ensure_loop()
+                fut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), loop)
+                result = fut.result()
+            elif state.serial:
+                with state.lock:
+                    result = method(*args, **kwargs)
+            else:
+                with state.slots:
+                    result = method(*args, **kwargs)
+            return self._package_results(spec, result)
+        except _DependencyFailed as df:
+            return self._package_error(spec, df.error)
+        except BaseException as exc:  # noqa: BLE001
+            return self._package_error(
+                spec,
+                TaskError.from_exception(
+                    f"{spec.function_name}.{spec.actor_method}", exc))
+
+    def _admit_in_order(self, state: _ActorState, spec: TaskSpec,
+                        timeout: float = 300.0) -> None:
+        """Per-caller sequence ordering (sequential_actor_submit_queue.cc):
+        requests may arrive on pool threads out of order; admit strictly by
+        the handle's sequence number.
+
+        The first sequence seen from a caller sets the baseline: a restarted
+        actor (fresh incarnation) may first hear from a handle mid-stream —
+        the caller's client-side dispatch is serialized per handle, so
+        whatever arrives first IS that handle's oldest outstanding call.
+        """
+        deadline = time.time() + timeout
+        with state.cv:
+            if spec.caller_id not in state.next_seq:
+                state.next_seq[spec.caller_id] = spec.sequence_number + 1
+                state.cv.notify_all()
+                return
+            while state.next_seq[spec.caller_id] < spec.sequence_number:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"actor task seq {spec.sequence_number} from "
+                        f"{spec.caller_id[:8]} starved (expected "
+                        f"{state.next_seq.get(spec.caller_id, 0)})")
+                state.cv.wait(timeout=min(remaining, 1.0))
+            state.next_seq[spec.caller_id] = spec.sequence_number + 1
+            state.cv.notify_all()
+
+    # ====================== lifecycle ======================
+
+    def ping(self) -> str:
+        return "pong"
+
+    def kill_self(self) -> None:
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),
+                         daemon=True).start()
+
+
+def _die_with_parent() -> None:
+    """SIGKILL this worker when the daemon dies (prctl PDEATHSIG) — the
+    reference relies on workers being raylet children + a subreaper
+    (``raylet/main.cc:33``); this closes the kill -9-the-daemon window
+    before the socket watchdog notices."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, _signal.SIGKILL)
+    except Exception:  # noqa: BLE001 — non-Linux: watchdog still covers it
+        pass
+
+
+def main() -> int:
+    _die_with_parent()
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    daemon_address = os.environ["RAY_TPU_DAEMON_ADDRESS"]
+    gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    store_name = os.environ.get("RAY_TPU_STORE_NAME", "")
+
+    core = CoreWorker(
+        gcs_address,
+        node_id=node_id,
+        node_address=daemon_address,
+        store_name=store_name,
+        job_id=JobID.from_int(0),
+        mode="worker",
+    )
+    from ray_tpu.core import runtime as runtime_mod
+
+    runtime_mod._global_runtime = core
+
+    service = WorkerService(core)
+    server = RpcServer(service, name=f"worker-{worker_id.hex()[:8]}")
+    daemon = RpcClient(daemon_address)
+    daemon.call("register_worker", worker_id, server.address)
+
+    # Watchdog: the daemon is this process's reason to live. If it goes away
+    # (kill -9, node death), exit so no orphan workers accumulate — the
+    # reference gets this from the raylet owning worker processes as children
+    # plus a subreaper (raylet/main.cc:33).
+    while True:
+        time.sleep(1.0)
+        try:
+            daemon.call("ping", timeout=5.0)
+        except (RpcConnectionError, TimeoutError):
+            logger.info("daemon unreachable; worker exiting")
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
