@@ -39,7 +39,7 @@ def main():
     rules = ShardingRules()
 
     import os
-    attn = os.environ.get("RT_BENCH_ATTN", "dense")
+    attn = os.environ.get("RT_BENCH_ATTN", "auto")
     if on_tpu:
         cfg = transformer.gpt2_small(
             max_seq_len=1024,

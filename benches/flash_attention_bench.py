@@ -1,0 +1,77 @@
+"""Flash vs dense attention, forward + backward, on the real chip.
+
+The long-context story: the Pallas kernels (block-512, O(L) memory) against
+the XLA dense path (O(L²) memory) across sequence lengths. Measured v5e
+results (B=4, H=12, D=64, bf16, causal):
+
+    L=1024: flash fwd ~5.9ms  grad ~4.3ms  | dense fwd ~6.0ms  grad ~7.7ms
+    L=2048: flash fwd ~6.7ms  grad ~7.6ms  | dense fwd ~11.6ms grad ~15.4ms
+    L=4096: flash fwd ~15.7ms grad ~20.7ms | dense fwd ~24.4ms grad ~51.9ms
+
+Prints one JSON line per sequence length.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import _dense_reference, flash_attention
+
+B, H, D = 4, 12, 64
+
+
+def _bench(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    on_tpu = jax.devices()[0].platform != "cpu"
+    seqs = (1024, 2048, 4096) if on_tpu else (256,)
+    for L in seqs:
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, L, H, D), jnp.bfloat16) for kk in ks)
+        g = jax.random.normal(jax.random.key(9), (B, L, H, D), jnp.bfloat16)
+        interp = not on_tpu
+
+        def loss_f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 512, 512, interp)
+                .astype(jnp.float32) * g.astype(jnp.float32))
+
+        def loss_d(q, k, v):
+            return jnp.sum(
+                _dense_reference(q, k, v, scale=D**-0.5, causal=True)
+                .astype(jnp.float32) * g.astype(jnp.float32))
+
+        fwd_f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, True, None, 512, 512, interp))
+        fwd_d = jax.jit(lambda q, k, v: _dense_reference(
+            q, k, v, scale=D**-0.5, causal=True))
+        grad_f = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))
+        grad_d = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))
+        iters = 20 if on_tpu else 2
+        rec = {
+            "metric": f"flash_attention_seq{L}",
+            "flash_fwd_ms": round(_bench(fwd_f, q, k, v, iters=iters), 2),
+            "dense_fwd_ms": round(_bench(fwd_d, q, k, v, iters=iters), 2),
+            "flash_grad_ms": round(_bench(grad_f, q, k, v, iters=iters), 2),
+            "dense_grad_ms": round(_bench(grad_d, q, k, v, iters=iters), 2),
+            "platform": jax.devices()[0].platform,
+        }
+        rec["fwd_speedup"] = round(rec["dense_fwd_ms"] / rec["flash_fwd_ms"], 2)
+        rec["grad_speedup"] = round(rec["dense_grad_ms"] / rec["flash_grad_ms"], 2)
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

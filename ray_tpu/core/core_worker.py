@@ -477,8 +477,6 @@ class CoreWorker:
         with self._cache_lock:
             for oid in return_ids:
                 self._pending[oid] = pending
-            if not isinstance(n, int):
-                self._pending_dynamic = getattr(self, "_pending_dynamic", {})
         self._submit_pool.submit(self._run_submission, spec, pending)
         return refs
 
@@ -495,6 +493,21 @@ class CoreWorker:
                 spec, pending,
                 TaskError.from_exception(spec.function_name, exc))
 
+    def _request_lease(self, resources, strategy):
+        """Lease with unbounded queueing in bounded server slices.
+
+        Each RPC asks the GCS to wait at most ~25s (its blocking handler
+        thread is a shared resource); TimeoutError means "still queued", so
+        loop — a task waits for resources indefinitely, like the reference's
+        raylet task queues, without pinning a GCS thread forever.
+        """
+        while True:
+            try:
+                return self._gcs_rpc.call(
+                    "request_lease", resources, strategy, 25.0, timeout=None)
+            except TimeoutError:
+                continue
+
     def _run_submission_inner(self, spec: TaskSpec, pending: _PendingTask) -> None:
         spec_bytes = serialization.dumps(spec)
         resources = dict(spec.options.resources)
@@ -506,10 +519,8 @@ class CoreWorker:
             while True:
                 attempt += 1
                 try:
-                    lease_id, node_id, node_addr = self._gcs_rpc.call(
-                        "request_lease", resources,
-                        spec.options.scheduling_strategy, timeout=None,
-                    )
+                    lease_id, node_id, node_addr = self._request_lease(
+                        resources, spec.options.scheduling_strategy)
                 except RpcConnectionError as e:
                     self._record_task_error(
                         spec, pending,
@@ -579,7 +590,13 @@ class CoreWorker:
                 self._cache[oid] = error
                 self._pending.pop(oid, None)
             if spec.task_id not in self._generators:
-                self._generators[spec.task_id] = []
+                # Dynamic-generator task (no pre-declared return ids): the
+                # error must still surface — publish a one-item stream whose
+                # single ref holds the error, so iteration raises at get()
+                # instead of silently yielding zero items.
+                err_oid = ObjectID.for_task_return(spec.task_id, 0)
+                self._cache[err_oid] = error
+                self._generators[spec.task_id] = [err_oid]
             self._cache_cv.notify_all()
         pending.error = error
         pending.done.set()
@@ -601,6 +618,10 @@ class CoreWorker:
         with self._cache_lock:
             for oid in return_ids:
                 self._pending[oid] = pending
+        # Pin argument refs for the duration of the call (the same borrow
+        # submit_task takes) so the owner can't free them mid-flight.
+        for dep in spec.dependencies():
+            self.reference_counter.add_submitted_task_reference(dep)
         self._enqueue_actor_call(spec, pending)
         return refs
 
@@ -669,6 +690,15 @@ class CoreWorker:
         spec_bytes = serialization.dumps(spec)
         failed_addrs: set = set()
         deadline = time.time() + 300.0
+        try:
+            self._run_actor_submission_loop(spec, pending, spec_bytes,
+                                            failed_addrs, deadline)
+        finally:
+            for dep in spec.dependencies():
+                self.reference_counter.remove_submitted_task_reference(dep)
+
+    def _run_actor_submission_loop(self, spec, pending, spec_bytes,
+                                   failed_addrs, deadline) -> None:
         while True:
             try:
                 addr = self._actor_address(spec.actor_id)

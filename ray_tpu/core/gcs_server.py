@@ -159,6 +159,7 @@ class GcsService:
                 self._actor_cv.notify_all()
             self._sched_cv.notify_all()
         self._publish("node", ("ALIVE", node_id.hex(), address))
+        self._reschedule_placement_groups()
         if getattr(self, "_pending_detached", None):
             # Nodes exist again: give daemons one health period to re-adopt
             # their live actors, then resurrect whichever detached actors
@@ -213,10 +214,12 @@ class GcsService:
                 if not locs:
                     self._objects.pop(oid, None)
             # PG bundles on the node lose their reservation.
+            needs_reschedule = False
             for pg in self._pgs.values():
                 for b in pg.bundles:
                     if b.node_id == node_id:
                         pg.state = "RESCHEDULING"
+                        needs_reschedule = True
             dead_actors = [
                 (aid, info) for aid, info in self.store.actors.items()
                 if info.node_id == node_id and info.state in ("ALIVE", "PENDING", "RESTARTING")
@@ -225,6 +228,8 @@ class GcsService:
         self._publish("node", ("DEAD", node_id.hex(), addr))
         for aid, info in dead_actors:
             self._on_actor_failure(aid, f"node {node_id.hex()[:8]} died")
+        if needs_reschedule:
+            self._reschedule_placement_groups()
 
     def drain_node(self, node_id: NodeID) -> None:
         """Graceful removal (autoscaler downscale path)."""
@@ -386,6 +391,42 @@ class GcsService:
             for node, req in zip(placed, requests):
                 self.scheduler.release(node, req)
             return None
+
+    def _reschedule_placement_groups(self) -> None:
+        """Re-place the dead-node bundles of RESCHEDULING groups.
+
+        The reference's GCS does the same after node failure
+        (``gcs_placement_group_manager`` re-queues damaged groups). Bundles
+        on surviving nodes keep their reservation; only lost bundles get a
+        fresh node. A group that can't fit yet stays RESCHEDULING and is
+        retried on the next membership change.
+        """
+        with self._lock:
+            for pg in self._pgs.values():
+                if pg.state != "RESCHEDULING":
+                    continue
+                lost = [b for b in pg.bundles
+                        if b.node_id not in self._node_addr]
+                placed = []
+                ok = True
+                for b in lost:
+                    node_id = self.scheduler.best_node(b.resources)
+                    if node_id is None or not self.scheduler.try_allocate(
+                            node_id, b.resources):
+                        ok = False
+                        break
+                    placed.append((b, node_id))
+                if not ok:
+                    for b, node_id in placed:
+                        self.scheduler.release(node_id, b.resources)
+                    continue
+                for b, node_id in placed:
+                    b.node_id = node_id
+                    b.in_use = ResourceSet()  # leases on it died with the node
+                pg.state = "CREATED"
+                logger.info("placement group %s re-placed after node death",
+                            pg.pg_id.hex()[:8])
+            self._sched_cv.notify_all()
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         with self._lock:

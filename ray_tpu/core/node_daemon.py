@@ -95,7 +95,12 @@ class NodeDaemon:
         num_cpus = resources.get("CPU", os.cpu_count() or 4)
         self._max_workers = max(int(num_cpus) * 2, cfg.max_workers_per_node)
 
-        self._server = RpcServer(self, host=host, name="raylet")
+        # Handler pool must exceed the worker cap: every in-flight
+        # execute_task occupies one handler for the task's duration, and
+        # worker watchdog pings + registrations must never starve behind
+        # them (workers self-terminate if pings stall 5s).
+        self._server = RpcServer(self, host=host, name="raylet",
+                                 max_workers=self._max_workers + 32)
         self.address = self._server.address
         self._resources = resources
         self._labels = labels or {}

@@ -1,4 +1,4 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 The hot op of the transformer stack. The reference delegates attention math to
 torch/framework kernels; TPU-native it is a Pallas kernel: grid over
@@ -7,11 +7,20 @@ TPU), online-softmax accumulators (m, l, acc) held in VMEM scratch across the
 kv sweep, causal blocks fully skipped via ``pl.when``, and the MXU fed
 (block_q × d) @ (d × block_k) tiles in f32 accumulation.
 
-Training integrates via ``jax.custom_vjp``: forward uses the kernel; backward
-recomputes attention with the XLA dense path (remat-style — the standard
-memory/compute trade; a dedicated backward kernel is a later optimization).
-Numerics are validated against ``parallel.ring_attention.reference_attention``
-in interpret mode on CPU.
+Training integrates via ``jax.custom_vjp``. The forward kernel additionally
+emits the row log-sum-exp; the backward is TWO Pallas kernels in the standard
+flash-attention-2 decomposition — O(L) memory, no materialized L×L
+probability matrix:
+
+- dQ kernel: fix a q block, sweep kv blocks; p is recomputed from (q, k,
+  lse), ``ds = p * (dO·Vᵀ - delta)``, ``dq += ds @ k``.
+- dK/dV kernel: fix a kv block, sweep q blocks; ``dv += pᵀ @ dO``,
+  ``dk += dsᵀ @ q``.
+
+``delta = rowsum(dO * O)`` is a cheap elementwise reduce left to XLA fusion.
+Sequence lengths not divisible by the block size fall back to the XLA dense
+path (odd L is never the perf-critical case). Numerics are validated against
+``parallel.ring_attention.reference_attention`` in interpret mode on CPU.
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ _NEG_INF = -1e30
 def _flash_kernel(
     q_ref, k_ref, v_ref,  # [1, block_q, d], [1, block_k, d]
     o_ref,                # [1, block_q, d]
+    lse_ref,              # [1, block_q, 1]
     m_scr, l_scr, acc_scr,  # VMEM scratch: [block_q, 1], [block_q, 1], [block_q, d]
     *,
     scale: float,
@@ -85,14 +95,16 @@ def _flash_kernel(
     def _finalize():
         denom = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(denom)).astype(lse_ref.dtype)
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, scale: float, causal: bool, block_q: int, block_k: int,
     interpret: bool,
-) -> jax.Array:
-    """q/k/v: [BH, L, D] (batch*heads flattened). Returns [BH, L, D]."""
+):
+    """q/k/v: [BH, L, D] (batch*heads flattened). Returns (o, lse):
+    o [BH, L, D], lse [BH, L, 1] (row log-sum-exp of scaled scores)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     assert lq % block_q == 0 and lk % block_k == 0, (
@@ -114,8 +126,14 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -123,6 +141,183 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,  # blocks (see specs)
+    dq_ref,                                           # [1, block_q, d]
+    dq_scr,                                           # VMEM [block_q, d] f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_blocks: int,
+):
+    """Fix a q block, sweep kv blocks (innermost): accumulate dq."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run if causal else True)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        g = g_ref[0].astype(jnp.float32)            # [bq, d]
+        lse = lse_ref[0]                            # [bq, 1] f32
+        delta = delta_ref[0]                        # [bq, 1] f32
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                    # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_start
+            scores = jnp.where(rows >= cols, scores, _NEG_INF)
+        p = jnp.exp(scores - lse)                    # [bq, bk]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # [bq, bk]
+        ds = p * (dp - delta) * scale                # [bq, bk]
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,                                  # [1, block_k, d]
+    dk_scr, dv_scr,                                  # VMEM [block_k, d] f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    q_blocks: int,
+):
+    """Fix a kv block, sweep q blocks (innermost): accumulate dk, dv."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        # A q block strictly before the kv block sees none of it.
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run if causal else True)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        g = g_ref[0].astype(jnp.float32)            # [bq, d]
+        lse = lse_ref[0]                            # [bq, 1]
+        delta = delta_ref[0]                        # [bq, 1]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                    # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_start
+            scores = jnp.where(rows >= cols, scores, _NEG_INF)
+        p = jnp.exp(scores - lse)                    # [bq, bk]
+        # dv += pᵀ @ g
+        dv_scr[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # [bk, d]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # [bq, bk]
+        ds = p * (dp - delta) * scale                # [bq, bk]
+        # dk += dsᵀ @ q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # [bk, d]
+
+    @pl.when(qi == q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, g, o, lse,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """All inputs [BH, L, D] (lse [BH, L, 1]); returns (dq, dk, dv)."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    q_blocks = lq // block_q
+    kv_blocks = lk // block_k
+    # delta_i = Σ_d dO_id · O_id — cheap rowwise reduce; XLA fuses it.
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [BH, L, 1]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_for_dq = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
+        ),
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[q_spec, kv_spec_for_dq, kv_spec_for_dq, q_spec,
+                  row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv: transposed sweep — kv block outer, q block inner.
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_blocks=q_blocks,
+        ),
+        grid=(bh, kv_blocks, q_blocks),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+                  row_spec_t, row_spec_t],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _dense_reference(q, k, v, *, scale, causal):
@@ -142,13 +337,23 @@ def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Multi-head attention, [B, L, H, D] layout (matches
     ``models.transformer``). Heads fold into the grid's batch dim."""
     return _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
+
+
+def _fold(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _unfold(x, b, h):
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -160,23 +365,33 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         # Odd sequence lengths: take the dense path rather than tracing a
         # kernel with ragged blocks (padding+masking inside the kernel is a
         # later optimization; odd L is never the perf-critical case).
-        return _dense_reference(q, k, v, scale=s, causal=causal), (q, k, v)
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out = _flash_forward(
-        fold(q), fold(k), fold(v),
+        return _dense_reference(q, k, v, scale=s, causal=causal), (q, k, v, None, None)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    of, lse = _flash_forward(
+        qf, kf, vf,
         scale=s, causal=causal, block_q=bq, block_k=bk, interpret=interpret,
     )
-    out = out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
-    return out, (q, k, v)
+    return _unfold(of, b, h), (q, k, v, of, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    s = scale if scale is not None else 1.0 / q.shape[-1] ** 0.5
-    # Recompute-through-XLA backward (remat): correct grads, O(L^2) compute,
-    # no O(L^2) residual storage from the forward.
-    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, scale=s, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, of, lse = res
+    b, l, h, d = q.shape
+    s = scale if scale is not None else 1.0 / d**0.5
+    if of is None:
+        # Dense-path residuals (ragged seq len): recompute-through-XLA.
+        _, vjp = jax.vjp(
+            lambda q, k, v: _dense_reference(q, k, v, scale=s, causal=causal),
+            q, k, v,
+        )
+        return vjp(g)
+    bq = min(block_q, l)
+    bk = min(block_k, k.shape[1])
+    dqf, dkf, dvf = _flash_backward(
+        _fold(q), _fold(k), _fold(v), _fold(g), of, lse,
+        scale=s, causal=causal, block_q=bq, block_k=bk, interpret=interpret,
+    )
+    return _unfold(dqf, b, h), _unfold(dkf, b, h), _unfold(dvf, b, h)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -368,3 +368,60 @@ def test_node_death_actor_restart_elsewhere():
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_dynamic_generator_error_surfaces(driver):
+    """A failed dynamic-generator task must raise at iteration, not yield an
+    empty stream."""
+
+    @ray_tpu.remote(num_returns="dynamic", max_retries=0)
+    def bad_gen():
+        yield 1
+        raise ValueError("gen kaboom")
+
+    gen = bad_gen.remote()
+    with pytest.raises(ValueError, match="gen kaboom"):
+        for ref in gen:
+            ray_tpu.get(ref, timeout=60)
+
+
+def test_dynamic_generator_success(driver):
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    vals = [ray_tpu.get(r, timeout=60) for r in gen.remote(4)]
+    assert vals == [0, 1, 4, 9]
+
+
+def test_placement_group_rescheduled_after_node_death():
+    """A PG bundle whose node dies is re-placed on a surviving node
+    (gcs_placement_group_manager re-queue analog)."""
+    from ray_tpu.core.placement_group import placement_group
+
+    cluster = Cluster(num_nodes=3, resources_per_node={"CPU": 1})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                                 strategy="STRICT_SPREAD")
+            assert pg.ready(timeout=30)
+            victim_node = pg.bundle_node_ids()[0]
+            idx = next(i for i, h in enumerate(cluster.nodes)
+                       if h.node_id == victim_node)
+            cluster.kill_node(idx)
+            # Health check marks the node dead, then the bundle re-places on
+            # the spare node.
+            assert _wait_for(
+                lambda: not core.gcs.nodes[victim_node].alive, timeout=30
+            ), "node death not detected"
+            assert _wait_for(
+                lambda: victim_node not in pg.bundle_node_ids()
+                and pg.ready(timeout=1), timeout=60
+            ), "PG was not re-placed"
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
