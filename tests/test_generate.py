@@ -109,3 +109,63 @@ class TestKVCache:
             np.asarray(logits[0, -1], np.float32),
             rtol=3e-4, atol=3e-4,
         )
+
+
+class TestChunkedDecode:
+    """serve/llm.py fast path: fused prefill + lax.scan decode chunks."""
+
+    @pytest.fixture()
+    def setup(self):
+        cfg = transformer.tiny(max_seq_len=64)
+        params = transformer.init_params(cfg, jax.random.key(0))
+        return cfg, params
+
+    def test_chunked_matches_per_token_greedy(self, setup):
+        cfg, params = setup
+        from ray_tpu.serve.llm import LLMEngine
+
+        prompt = [3, 1, 4, 1, 5]
+        g = Generator(params, cfg, batch=1)
+        oracle = g.generate(prompt, max_new_tokens=12)
+        eng = LLMEngine(params, cfg, chunk=4)
+        got = eng.generate(prompt, max_new_tokens=12)
+        assert got == oracle
+
+    def test_bucket_padding_is_invisible(self, setup):
+        """Prompt of 5 pads to bucket 16; tokens must match the unpadded
+        per-token oracle (pad K/V never attendable)."""
+        cfg, params = setup
+        from ray_tpu.serve.llm import LLMEngine
+
+        eng = LLMEngine(params, cfg, chunk=4, prompt_buckets=(16, 64))
+        prompt = [7, 2, 9]
+        got = eng.generate(prompt, max_new_tokens=8)
+        oracle = Generator(params, cfg, batch=1).generate(prompt, max_new_tokens=8)
+        assert got == oracle
+
+    def test_sampled_stream_runs(self, setup):
+        cfg, params = setup
+        from ray_tpu.serve.llm import LLMEngine
+
+        eng = LLMEngine(params, cfg, chunk=4)
+        toks = eng.generate([1, 2], max_new_tokens=6, temperature=0.8, seed=3)
+        assert len(toks) == 6
+        assert all(0 <= t < cfg.vocab_size for t in toks)
+
+    def test_prompt_too_long_raises(self, setup):
+        cfg, params = setup
+        from ray_tpu.serve.llm import LLMEngine
+
+        eng = LLMEngine(params, cfg, chunk=8)  # max_len 64
+        with pytest.raises(ValueError, match="no room"):
+            eng.generate(list(range(1, 60)), max_new_tokens=4)
+
+    def test_length_cap_finish_reason(self, setup):
+        cfg, params = setup
+        from ray_tpu.serve.llm import LLMEngine
+
+        eng = LLMEngine(params, cfg, chunk=8)  # max_len 64
+        # 16-token prompt leaves 48 slots = 6 chunks; ask for more.
+        toks = eng.generate([1] * 16, max_new_tokens=100)
+        assert len(toks) == 48
+        assert eng.finish_reason == "length_cap"
