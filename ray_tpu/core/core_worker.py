@@ -342,6 +342,8 @@ class CoreWorker:
     def _get_one(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
         backoff = 0.001
+        missing_since: float | None = None
+        recovered = False
         while True:
             with self._cache_lock:
                 if oid in self._cache:
@@ -366,10 +368,51 @@ class CoreWorker:
                     self._cache[oid] = value
                     self._cache_cv.notify_all()
                 return value
+            # Lineage-based recovery (object_recovery_manager.h:41): the
+            # object has no live replica — if the GCS kept its creating
+            # TaskSpec, resubmit it once; the re-executed task re-seals the
+            # same return ids. Brief grace first (a fresh task's seal may
+            # not have landed), then probe the lineage table at most once
+            # per second so waiting consumers don't hot-loop the GCS.
+            now = time.time()
+            missing_since = missing_since or now
+            if (not recovered and pending is None
+                    and now - missing_since > 0.5
+                    and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
+                self._last_lineage_probe = now
+                if self._maybe_recover(oid):
+                    recovered = True
+                    missing_since = None
+                    continue
             if deadline is not None and time.time() >= deadline:
                 raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.1)
+
+    def _maybe_recover(self, oid: ObjectID) -> bool:
+        """Resubmit the task that created ``oid`` (lineage reconstruction)."""
+        try:
+            lineage = self._gcs_rpc.call("get_lineage", oid.binary())
+        except RpcConnectionError:
+            return False
+        if lineage is None:
+            return False
+        spec: TaskSpec = serialization.loads(lineage)
+        return_ids = spec.return_object_ids()
+        pending = _PendingTask(return_ids)
+        with self._cache_lock:
+            if oid in self._pending:
+                return True  # another thread is already reconstructing
+            for rid in return_ids:
+                self._pending[rid] = pending
+        logger.warning("object %s lost — reconstructing via lineage resubmit "
+                       "of %s", oid.hex()[:12], spec.function_name)
+        # Symmetry with submit_task: _run_submission's finally decrements
+        # these; without the increment a recovery could free a dep we own.
+        for dep in spec.dependencies():
+            self.reference_counter.add_submitted_task_reference(dep)
+        self._submit_pool.submit(self._run_submission, spec, pending)
+        return True
 
     def _try_fetch(self, oid: ObjectID):
         """Local shm → local daemon → remote daemons (pull manager path)."""

@@ -34,7 +34,10 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.core.rpc import RpcClientPool, RpcConnectionError, RpcServer
 from ray_tpu.core.scheduler import ClusterResourceScheduler
-from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+from ray_tpu.core.task_spec import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("gcs_server")
@@ -87,8 +90,13 @@ class GcsService:
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
         # object directory: object id bytes -> {node_id: size}
         self._objects: Dict[bytes, Dict[NodeID, int]] = {}
-        # lineage hook (object recovery): object id -> pickled creating TaskSpec
-        self._lineage: Dict[bytes, bytes] = {}
+        # Lineage for object recovery, deduplicated per creating TASK (all of
+        # a task's return ids share the 24-byte TaskID prefix — one pickled
+        # spec serves every return/stream item). FIFO-capped as a backstop.
+        self._lineage: Dict[bytes, bytes] = {}  # task_id bytes -> spec bytes
+        self._lineage_cap = 10_000
+        # task_id bytes -> live object ids, to GC lineage with its objects
+        self._task_objects: Dict[bytes, set] = {}
         # actor bookkeeping for restart: actor id -> pickled creation spec
         self._actor_specs: Dict[ActorID, bytes] = {}
         self._actor_addr: Dict[ActorID, str] = {}
@@ -255,6 +263,16 @@ class GcsService:
             bundle_index = strategy.placement_group_bundle_index
         with self._lock:
             while True:
+                if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+                        and not strategy.soft
+                        and strategy.node_id in self._dead_nodes):
+                    # Hard affinity to a KNOWN-dead node can never be
+                    # satisfied — fail now instead of queueing forever.
+                    # (A merely unknown node may still be registering, e.g.
+                    # right after a GCS restart — those requests wait.)
+                    raise RuntimeError(
+                        f"no feasible node: hard affinity to dead node "
+                        f"{strategy.node_id}")
                 if pg_id is not None:
                     got = self._try_pg_lease(pg_id, bundle_index, request)
                 else:
@@ -598,12 +616,22 @@ class GcsService:
 
     # ====================== object directory ======================
 
+    @staticmethod
+    def _task_key(object_id: bytes) -> bytes:
+        return object_id[:24]  # ObjectID = TaskID(24) + return index (4)
+
     def add_object_location(self, object_id: bytes, node_id: NodeID,
                             size: int, lineage: bytes | None = None) -> None:
         with self._lock:
             self._objects.setdefault(object_id, {})[node_id] = size
-            if lineage is not None:
-                self._lineage[object_id] = lineage
+            # Track task membership for every sealed object (siblings may
+            # register before the lineage-bearing first return arrives).
+            tk = self._task_key(object_id)
+            self._task_objects.setdefault(tk, set()).add(object_id)
+            if lineage is not None and tk not in self._lineage:
+                if len(self._lineage) >= self._lineage_cap:
+                    self._lineage.pop(next(iter(self._lineage)))
+                self._lineage[tk] = lineage
 
     def remove_object_location(self, object_id: bytes, node_id: NodeID) -> None:
         with self._lock:
@@ -625,12 +653,19 @@ class GcsService:
 
     def get_lineage(self, object_id: bytes) -> Optional[bytes]:
         with self._lock:
-            return self._lineage.get(object_id)
+            return self._lineage.get(self._task_key(object_id))
 
     def free_object(self, object_id: bytes) -> None:
         with self._lock:
             locs = self._objects.pop(object_id, {})
-            self._lineage.pop(object_id, None)
+            tk = self._task_key(object_id)
+            live = self._task_objects.get(tk)
+            if live is not None:
+                live.discard(object_id)
+                if not live:
+                    # Last of the task's outputs freed → lineage goes too.
+                    self._task_objects.pop(tk, None)
+                    self._lineage.pop(tk, None)
             targets = [(n, self._node_addr.get(n)) for n in locs]
         for node_id, addr in targets:
             if addr is None:

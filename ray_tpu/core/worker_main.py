@@ -84,7 +84,7 @@ class WorkerService:
                 raise RuntimeError(f"function {spec.function_id} not in GCS")
             args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
-            return self._package_results(spec, result)
+            return self._package_results(spec, result, lineage=spec_bytes)
         except _DependencyFailed as df:
             return self._package_error(spec, df.error)
         except BaseException as exc:  # noqa: BLE001 — wire to the caller
@@ -106,13 +106,24 @@ class WorkerService:
         kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
         return args, kwargs
 
-    def _package_results(self, spec: TaskSpec, result) -> dict:
+    def _package_results(self, spec: TaskSpec, result,
+                         lineage: bytes | None = None) -> dict:
+        # Lineage (the pickled creating TaskSpec) rides with every sealed
+        # return of a NORMAL task so the cluster can reconstruct the object
+        # by resubmission after node loss (object_recovery_manager.h:41).
+        # Actor-task outputs are not reconstructable (state-dependent), same
+        # as the reference.
+        from ray_tpu.core.task_spec import TaskType
+
+        if spec.task_type is not TaskType.NORMAL_TASK:
+            lineage = None
         n = spec.options.num_returns
         if n in ("dynamic", "streaming"):
             items: List[bytes] = []
             for i, item in enumerate(result):
                 oid = ObjectID.for_task_return(spec.task_id, i)
-                self._seal_return(oid, item)
+                # Lineage ships once per task (GCS keys it by TaskID prefix).
+                self._seal_return(oid, item, lineage if i == 0 else None)
                 items.append(oid.binary())
             return {"ok": True, "returns": [], "generator_items": items}
         if n == 0:
@@ -127,12 +138,14 @@ class WorkerService:
         inline_cap = config().max_inline_object_size
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
-            payload = self._seal_return(oid, value)
+            payload = self._seal_return(oid, value,
+                                        lineage if i == 0 else None)
             inline = payload if len(payload) <= inline_cap else None
             returns.append((oid.binary(), inline))
         return {"ok": True, "returns": returns}
 
-    def _seal_return(self, oid: ObjectID, value) -> bytes:
+    def _seal_return(self, oid: ObjectID, value,
+                     lineage: bytes | None = None) -> bytes:
         """Seal a return object so any process can fetch it; returns payload.
 
         Small returns also ride inline in the reply (the reference's
@@ -148,12 +161,14 @@ class WorkerService:
             try:
                 core._shm.put(NodeDaemon._shm_key(oid.binary()), payload)
                 core._gcs_rpc.notify("add_object_location", oid.binary(),
-                                     core.current_node_id, len(payload), None)
+                                     core.current_node_id, len(payload),
+                                     lineage)
                 return payload
             except Exception:  # noqa: BLE001 — arena full → daemon heap
                 pass
         try:
-            core._local_daemon.notify("put_object", oid.binary(), payload, None)
+            core._local_daemon.notify("put_object", oid.binary(), payload,
+                                      lineage)
         except RpcConnectionError:
             logger.warning("daemon unreachable sealing %s", oid.hex()[:12])
         return payload

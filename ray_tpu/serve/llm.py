@@ -98,20 +98,30 @@ class LLMEngine:
         raise ValueError(f"prompt of {n} tokens exceeds max_len {self.max_len}")
 
     def stream(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 32,
-               temperature: float = 0.0, seed: int = 0) -> Iterable[int]:
+               temperature: float = 0.0, seed: int = 0,
+               result: Optional[Dict] = None) -> Iterable[int]:
         """Yield generated token ids, ``chunk`` tokens per device dispatch.
 
         The sampling loop runs on-device inside a ``lax.scan`` — K tokens
         cost ONE host↔device round trip, which is the whole game on a
         tunneled chip (~100 ms RTT) and still 10-20% on a colocated host.
+
+        ``result``, if given, receives ``{"finish_reason": ...}`` — pass a
+        fresh dict per request; the engine-level ``finish_reason`` attribute
+        is a convenience for single-stream use and races under concurrency.
         """
         import jax
         import jax.numpy as jnp
 
+        if result is None:
+            result = {}
         prompt = np.asarray(prompt_ids, np.int32)
         real_len = int(prompt.shape[0])
         if real_len == 0:
             raise ValueError("empty prompt")
+        if max_new_tokens <= 0:
+            result["finish_reason"] = self.finish_reason = "stop"
+            return
         bucket = self._bucket_for(real_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :real_len] = prompt
@@ -126,7 +136,7 @@ class LLMEngine:
             jnp.asarray(real_len, jnp.int32), rng, temp)
         emitted = 0
         host_pos = real_len + self.chunk  # device pos mirrors this exactly
-        self.finish_reason = "stop"
+        result["finish_reason"] = self.finish_reason = "stop"
         dispatched_at = None  # dispatch time of the chunk in `toks` (dec only)
         while True:
             host_toks = np.asarray(toks)[0]  # sync point: one per chunk
@@ -150,7 +160,7 @@ class LLMEngine:
                     return
             if nxt is None:
                 # No room for another full chunk: context-length cap.
-                self.finish_reason = "length_cap"
+                result["finish_reason"] = self.finish_reason = "length_cap"
                 return
             toks, last, cache, pos, rng = nxt
             dispatched_at = next_dispatched_at
@@ -245,15 +255,19 @@ def llm_deployment(
             self.engine.warmup()
 
         def __call__(self, payload):
-            prompt = payload.get("prompt_ids") or [1] * int(
-                payload.get("prompt_len", 8))
+            if "prompt_ids" in payload:
+                prompt = payload["prompt_ids"]  # empty list → engine raises
+            else:
+                prompt = [1] * int(payload.get("prompt_len", 8))
             n = int(payload.get("max_new_tokens", max_new_tokens_default))
             temp = float(payload.get("temperature", 0.0))
             seed = payload.get("seed")
             if seed is None:
                 seed = _random.getrandbits(31)
+            outcome: dict = {}  # per-request, not the shared engine attr
             stream = self.engine.stream(
-                prompt, max_new_tokens=n, temperature=temp, seed=int(seed))
+                prompt, max_new_tokens=n, temperature=temp, seed=int(seed),
+                result=outcome)
             prev: dict | None = None
             for i, tok in enumerate(stream):
                 if prev is not None:
@@ -261,7 +275,7 @@ def llm_deployment(
                 prev = {"token": tok, "index": i,
                         "decode_tps": round(self.engine.decode_tokens_per_sec(), 1)}
             if prev is not None:
-                prev["finish_reason"] = self.engine.finish_reason
+                prev["finish_reason"] = outcome.get("finish_reason", "stop")
                 yield prev
 
     return LLMServer
