@@ -1,9 +1,14 @@
-"""PPO env-steps/sec benchmark (north-star metric #2, BASELINE.json).
+"""RL throughput benchmarks (north-star metric #2, BASELINE.json).
 
-CartPole PPO through the full stack (EnvRunner sampling + GAE + learner SGD
-epochs), reporting end-to-end environment steps per second.
+Three lines of JSON:
 
-Prints one JSON line: {"metric": "ppo_env_steps_per_sec", ...}
+- CartPole PPO through the full stack (EnvRunner sampling + GAE + learner
+  SGD epochs) — end-to-end env steps/sec;
+- Atari-style pixel PPO (conv RLModule; real ALE when installed, the
+  synthetic Pong stand-in otherwise — ``rllib/envs.py``) — the north star's
+  actual workload shape: conv inference per env step, pixel batches through
+  the object plane, conv training on device;
+- IMPALA async (V-trace, in-flight sampling) on the same pixel env.
 """
 
 from __future__ import annotations
@@ -13,45 +18,91 @@ import json
 import numpy as np
 
 
-def main():
-    import ray_tpu
-    from ray_tpu.rllib import PPOConfig
+def _cartpole():
+    import gymnasium as gym
 
-    def cartpole():
-        import gymnasium as gym
+    return gym.make("CartPole-v1")
 
-        return gym.make("CartPole-v1")
 
-    ray_tpu.init()
-    algo = (
-        PPOConfig()
-        .environment(cartpole)
-        .env_runners(num_envs_per_env_runner=16)
-        .training(
-            rollout_fragment_length=128,
-            num_epochs=2,
-            minibatch_size=256,
-            seed=0,
-        )
-        .build()
-    )
+def _atari():
+    from ray_tpu.rllib.envs import make_atari
+
+    return make_atari()
+
+
+def _run(algo, iters=3):
     algo.train()  # warmup: jit compiles
     rates = []
-    for _ in range(3):
+    for _ in range(iters):
         result = algo.train()
         rates.append(result["env_steps_per_sec"])
     algo.stop()
-    ray_tpu.shutdown()
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_env_steps_per_sec",
-                "value": round(float(np.mean(rates)), 1),
-                "unit": "env_steps/s",
-                "last_return": round(float(result["episode_return_mean"]), 1),
-            }
-        )
+    return rates, result
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.rllib import ImpalaConfig, PPOConfig
+
+    ray_tpu.init()
+
+    algo = (
+        PPOConfig()
+        .environment(_cartpole)
+        .env_runners(num_envs_per_env_runner=16)
+        .training(rollout_fragment_length=128, num_epochs=2,
+                  minibatch_size=256, seed=0)
+        .build()
     )
+    rates, result = _run(algo)
+    print(json.dumps({
+        "metric": "ppo_env_steps_per_sec",
+        "value": round(float(np.mean(rates)), 1),
+        "unit": "env_steps/s",
+        "last_return": round(float(result["episode_return_mean"]), 1),
+    }))
+
+    env_kind = "ale" if _is_ale() else "synthetic"
+    algo = (
+        PPOConfig()
+        .environment(_atari)
+        .env_runners(num_envs_per_env_runner=4)
+        .training(rollout_fragment_length=32, num_epochs=1,
+                  minibatch_size=128, hidden=(), seed=0)
+        .build()
+    )
+    rates, result = _run(algo)
+    print(json.dumps({
+        "metric": "ppo_atari_env_steps_per_sec",
+        "value": round(float(np.mean(rates)), 1),
+        "unit": "env_steps/s",
+        "env": env_kind,
+    }))
+
+    algo = (
+        ImpalaConfig()
+        .environment(_atari)
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=2)
+        .training(rollout_fragment_length=32, seed=0)
+        .build()
+    )
+    rates, result = _run(algo)
+    print(json.dumps({
+        "metric": "impala_atari_env_steps_per_sec",
+        "value": round(float(np.mean(rates)), 1),
+        "unit": "env_steps/s",
+        "env": env_kind,
+    }))
+    ray_tpu.shutdown()
+
+
+def _is_ale() -> bool:
+    try:
+        import ale_py  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
 
 
 if __name__ == "__main__":

@@ -6,6 +6,8 @@ not ported — SURVEY §7 "do NOT port").
 """
 
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
+from ray_tpu.rllib.impala import IMPALA, AggregatorActor, ImpalaConfig, ImpalaLearner, vtrace
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec, spec_for_env
@@ -15,10 +17,17 @@ __all__ = [
     "RLModuleSpec",
     "spec_for_env",
     "SingleAgentEnvRunner",
+    "SyntheticAtariEnv",
+    "make_atari",
     "Learner",
     "LearnerGroup",
     "PPO",
     "PPOConfig",
     "PPOLearner",
     "compute_gae",
+    "IMPALA",
+    "ImpalaConfig",
+    "ImpalaLearner",
+    "AggregatorActor",
+    "vtrace",
 ]

@@ -85,7 +85,10 @@ class SingleAgentEnvRunner:
         bootstrap values for GAE (shape [T, N, ...] flattened to [T*N, ...]
         AFTER advantage computation by the algorithm — kept 2D here)."""
         T, N = num_steps, self.num_envs
-        obs_buf = np.zeros((T, N, self.spec.observation_dim), np.float32)
+        # Pixel obs stay uint8 end-to-end (the conv torso casts /255 on
+        # device) — 4x less object-plane traffic than float32.
+        obs_dtype = np.uint8 if self.spec.conv else np.float32
+        obs_buf = np.zeros((T, N, self.spec.observation_dim), obs_dtype)
         act_shape = (T, N) if self.spec.discrete else (T, N, self.spec.action_dim)
         act_buf = np.zeros(act_shape, np.float32)
         logp_buf = np.zeros((T, N), np.float32)
@@ -96,7 +99,7 @@ class SingleAgentEnvRunner:
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            obs = np.asarray(self._obs, np.float32).reshape(N, -1)
+            obs = np.asarray(self._obs, obs_dtype).reshape(N, -1)
             # numpy → CPU device directly: jnp.asarray would materialize on
             # the DEFAULT device first (a tunnel round trip per env step when
             # the default device is a remote TPU)
@@ -132,7 +135,7 @@ class SingleAgentEnvRunner:
             self._obs = next_obs
 
         # bootstrap value of the final observation
-        last_obs = np.asarray(self._obs, np.float32).reshape(N, -1)
+        last_obs = np.asarray(self._obs, obs_dtype).reshape(N, -1)
         out = self.module.forward_inference(
             self._params, jax.device_put(last_obs, self._device)
         )
@@ -147,6 +150,9 @@ class SingleAgentEnvRunner:
             "terminateds": done_buf,
             "valids": valid_buf,
             "bootstrap_value": last_val,
+            # Off-policy learners (V-trace) re-evaluate the bootstrap under
+            # the CURRENT policy — they need the obs, not our stale value.
+            "bootstrap_obs": last_obs,
         }
 
     def get_metrics(self) -> Dict[str, float]:

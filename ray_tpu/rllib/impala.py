@@ -1,0 +1,309 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Analog of the reference's ``rllib/algorithms/impala/impala.py`` (async
+``training_step`` :620-667 with aggregator workers and in-flight request
+management). The shape:
+
+- EnvRunner actors sample continuously under a (slightly stale) policy; the
+  driver keeps ``max_requests_in_flight`` sample calls outstanding per
+  runner and consumes whichever finishes first (``ray_tpu.wait``).
+- Optional **aggregator actors** (``impala.py:620-630``) concatenate several
+  rollout fragments into one learner-sized batch off the driver's critical
+  path — fragments travel by ObjectRef, so pixel batches ride the shm object
+  plane, not the driver.
+- The Learner applies **V-trace** (Espeholt et al. 2018): importance-clipped
+  off-policy returns computed INSIDE the jitted loss with the current
+  policy's log-probs, exactly as the reference's torch learner does.
+- Weights broadcast to runners every ``broadcast_interval`` updates (the
+  staleness knob that buys the async throughput).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.algorithm_config import AlgorithmConfigBase
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import spec_for_env
+
+
+def vtrace(
+    behavior_logp,   # [T, N]
+    target_logp,     # [T, N]
+    rewards,         # [T, N]
+    values,          # [T, N]  V(x_t) under the CURRENT policy's critic
+    bootstrap_value,  # [N]    V(x_T)
+    terminateds,     # [T, N]  1.0 where the episode truly ended at t
+    *,
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    valids=None,     # [T, N]  0 on autoreset (junk) steps — see compute_gae
+):
+    """V-trace targets vs_t and policy-gradient advantages (jax, scan).
+
+    vs_t = V_t + Σ_{k≥t} γ^{k-t} (Π_{i<k} c_i) δ_k with clipped importance
+    weights ρ, c (Espeholt et al. 2018 eq. 1); computed right-to-left via
+    ``lax.scan``. Discounts are cut at terminations. ``valids`` zeros the
+    accumulator at autoreset steps (same trick as ``compute_gae``): the
+    junk step's vs collapses to V_t, so the PRECEDING step's delta
+    bootstraps through V(final obs) — the truncation bootstrap — and
+    nothing leaks across the episode boundary.
+    """
+    rho = jnp.minimum(rho_bar, jnp.exp(target_logp - behavior_logp))
+    c = jnp.minimum(c_bar, jnp.exp(target_logp - behavior_logp))
+    discount = gamma * (1.0 - terminateds)
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)    # [T, N]
+    deltas = rho * (rewards + discount * next_values - values)
+    if valids is None:
+        valids = jnp.ones_like(rewards)
+
+    def backward(acc, xs):
+        delta_t, disc_t, c_t, valid_t = xs
+        acc = (delta_t + disc_t * c_t * acc) * valid_t
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discount, c, valids), reverse=True)
+    vs = vs_minus_v + values
+    # PG advantage uses vs_{t+1} (bootstrap for the final step).
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + discount * vs_next - values)
+    return vs, pg_adv
+
+
+class ImpalaLearner(Learner):
+    """V-trace actor-critic loss over [T, N] trajectory batches."""
+
+    def loss_fn(self, params, batch):
+        cfg = self.config
+        T, N = batch["rewards"].shape
+        obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+        actions = batch["actions"].reshape((T * N,) + batch["actions"].shape[2:])
+        logp, entropy, values = self.module.logp_and_entropy(
+            params, obs, actions)
+        logp = logp.reshape(T, N)
+        values = values.reshape(T, N)
+        entropy = entropy.reshape(T, N)
+        bootstrap = self.module.forward_train(
+            params, batch["bootstrap_obs"])["vf_preds"]
+        valids = batch.get("valids")
+        if valids is None:
+            valids = jnp.ones_like(logp)
+        vs, pg_adv = vtrace(
+            batch["logp"], logp, batch["rewards"],
+            jax.lax.stop_gradient(values),
+            jax.lax.stop_gradient(bootstrap),
+            batch["terminateds"],
+            gamma=cfg["gamma"],
+            rho_bar=cfg.get("rho_bar", 1.0),
+            c_bar=cfg.get("c_bar", 1.0),
+            valids=valids,
+        )
+        w = valids / jnp.maximum(valids.sum(), 1.0)
+        pg_loss = -jnp.sum(logp * jax.lax.stop_gradient(pg_adv) * w)
+        vf_loss = 0.5 * jnp.sum((values - jax.lax.stop_gradient(vs)) ** 2 * w)
+        ent = jnp.sum(entropy * w)
+        return (pg_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                - cfg.get("entropy_coeff", 0.01) * ent)
+
+
+class AggregatorActor:
+    """Concatenates rollout fragments into learner batches
+    (reference: ``impala.py:620-630`` AggregatorWorker)."""
+
+    def aggregate(self, *fragments: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for key in fragments[0]:
+            if key == "bootstrap_value":
+                out[key] = np.concatenate([f[key] for f in fragments], axis=0)
+            elif key == "bootstrap_obs":
+                out[key] = np.concatenate([f[key] for f in fragments], axis=0)
+            else:
+                # [T, N, ...] fragments concat on the env axis.
+                out[key] = np.concatenate([f[key] for f in fragments], axis=1)
+        return out
+
+
+@dataclass
+class ImpalaConfig(AlgorithmConfigBase):
+    env: Optional[Callable[[], Any]] = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    num_aggregators: int = 0
+    max_requests_in_flight: int = 2
+    broadcast_interval: int = 1          # updates between weight broadcasts
+    train_batch_fragments: int = 1       # fragments per learner update
+    gamma: float = 0.99
+    lr: float = 5e-4
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    grad_clip: float = 40.0
+    seed: int = 0
+    hidden: Optional[tuple] = None
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    """Async actor-learner algorithm (Tune-compatible train() contract)."""
+
+    def __init__(self, config: ImpalaConfig):
+        assert config.env is not None, "config.environment(env_creator) required"
+        self.config = config
+        probe = config.env()
+        self.spec = spec_for_env(probe)
+        if config.hidden and not self.spec.conv:
+            import dataclasses
+
+            self.spec = dataclasses.replace(self.spec,
+                                            hidden=tuple(config.hidden))
+        probe.close()
+
+        self.learner = ImpalaLearner(self.spec, {
+            "lr": config.lr, "gamma": config.gamma,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
+            "rho_bar": config.rho_bar, "c_bar": config.c_bar,
+            "grad_clip": config.grad_clip,
+        }, seed=config.seed)
+
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            runner_cls.remote(
+                config.env, num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, spec=self.spec,
+            )
+            for i in range(max(1, config.num_env_runners))
+        ]
+        if config.num_aggregators > 0:
+            agg_cls = ray_tpu.remote(AggregatorActor)
+            self._aggregators = [agg_cls.remote()
+                                 for _ in range(config.num_aggregators)]
+        else:
+            self._aggregators = []
+        self._agg_rr = 0
+        self._inflight: Dict[Any, Any] = {}  # sample ref -> runner
+        self._pending_frags: List[Any] = []  # carried across train() calls
+        self._updates = 0
+        self._iteration = 0
+        self._timesteps = 0
+        self._broadcast()
+
+    def _broadcast(self):
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
+
+    def _launch(self, runner):
+        ref = runner.sample.remote(self.config.rollout_fragment_length)
+        self._inflight[ref] = runner
+
+    def _to_train_batch(self, sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        batch = dict(sample)
+        # V-trace bootstraps through V(x_T) of the CURRENT policy — ship the
+        # final obs, drop the runner's stale value estimate.
+        batch.pop("bootstrap_value", None)
+        return batch
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: consume ``num_env_runners`` fragments worth of
+        experience asynchronously, updating as results land."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        for runner in self._runners:
+            while sum(1 for r, w in self._inflight.items() if w is runner) \
+                    < cfg.max_requests_in_flight:
+                self._launch(runner)
+
+        target_fragments = max(len(self._runners), cfg.train_batch_fragments)
+        consumed = 0
+        losses = []
+        sampled_steps = 0
+        # Every fragment trains exactly once: leftovers persist on self so
+        # aggregation never discards experience, and the loop runs until at
+        # least one update landed (fragment targets not divisible by
+        # train_batch_fragments would otherwise yield loss=nan iterations).
+        while consumed < target_fragments or not losses:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=120.0)
+            if not ready:
+                raise TimeoutError("no sample fragment arrived in 120s")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            self._launch(runner)  # keep the pipeline full
+            consumed += 1
+            T, N = cfg.rollout_fragment_length, cfg.num_envs_per_runner
+            sampled_steps += T * N
+            if self._aggregators:
+                self._pending_frags.append(ref)
+                if len(self._pending_frags) < cfg.train_batch_fragments:
+                    continue
+                agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+                self._agg_rr += 1
+                batch_ref = agg.aggregate.remote(*self._pending_frags)
+                self._pending_frags = []
+                batch = self._to_train_batch(ray_tpu.get(batch_ref))
+            else:
+                batch = self._to_train_batch(ray_tpu.get(ref))
+            losses.append(self.learner.update(batch)["loss"])
+            self._updates += 1
+            if self._updates % cfg.broadcast_interval == 0:
+                self._broadcast()
+
+        self._timesteps += sampled_steps
+        self._iteration += 1
+        metrics = ray_tpu.get([r.get_metrics.remote() for r in self._runners])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["num_episodes"] > 0]
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "env_steps_per_sec": sampled_steps / dt,
+            "num_updates": self._updates,
+            "time_total_s": dt,
+        }
+
+    def save(self, path: str) -> str:
+        from ray_tpu.train.checkpoint import save_pytree
+
+        save_pytree({"params": self.learner.get_state()["params"],
+                     "iteration": self._iteration,
+                     "timesteps": self._timesteps}, path)
+        return path
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import load_pytree
+
+        data = load_pytree(path)
+        state = self.learner.get_state()
+        state["params"] = data["params"]
+        self.learner.set_state(state)
+        self._iteration = int(data["iteration"])
+        self._timesteps = int(data["timesteps"])
+        self._broadcast()
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        for r in self._runners + self._aggregators:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

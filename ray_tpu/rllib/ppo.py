@@ -19,13 +19,14 @@ import jax
 import jax.numpy as jnp
 
 import ray_tpu
+from ray_tpu.rllib.algorithm_config import AlgorithmConfigBase
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.rl_module import RLModuleSpec, spec_for_env
 
 
 @dataclass
-class PPOConfig:
+class PPOConfig(AlgorithmConfigBase):
     """Reference: ``rllib/algorithms/ppo/ppo.py PPOConfig`` +
     ``algorithm_config.py`` builder style (``.environment().training()...``
     collapsed into one dataclass)."""
@@ -47,25 +48,6 @@ class PPOConfig:
     grad_clip: float = 0.5
     seed: int = 0
     hidden: tuple = (64, 64)
-
-    # builder-style sugar for API parity
-    def environment(self, env) -> "PPOConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None) -> "PPOConfig":
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_env_runner is not None:
-            self.num_envs_per_runner = num_envs_per_env_runner
-        return self
-
-    def training(self, **kw) -> "PPOConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown PPO option {k}")
-            setattr(self, k, v)
-        return self
 
     def learners(self, *, num_learners: int) -> "PPOConfig":
         self.num_learners = num_learners
@@ -145,13 +127,14 @@ class PPO:
         self.config = config
         probe = config.env()
         self.spec = spec_for_env(probe)
-        if config.hidden:
-            self.spec = RLModuleSpec(
-                observation_dim=self.spec.observation_dim,
-                action_dim=self.spec.action_dim,
-                hidden=tuple(config.hidden),
-                discrete=self.spec.discrete,
-            )
+        if config.hidden and not self.spec.conv:
+            # Pixel specs keep their conv torso + (512,) head regardless of
+            # the MLP default; dataclasses.replace preserves every other
+            # field so new spec knobs can't silently drop here.
+            import dataclasses
+
+            self.spec = dataclasses.replace(self.spec,
+                                            hidden=tuple(config.hidden))
         probe.close()
 
         learner_cfg = {

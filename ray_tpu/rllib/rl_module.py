@@ -28,6 +28,10 @@ class RLModuleSpec:
     hidden: Tuple[int, ...] = (64, 64)
     discrete: bool = True
     free_log_std: bool = True  # Box spaces: state-independent log-std
+    # Pixel observations: raw [H, W, C] shape + a Nature-CNN torso
+    # (reference: rllib/models/catalog defaults for Atari).
+    obs_shape: Optional[Tuple[int, ...]] = None
+    conv: bool = False
 
 
 class RLModule:
@@ -36,9 +40,14 @@ class RLModule:
     def __init__(self, spec: RLModuleSpec):
         self.spec = spec
 
+    # Nature-CNN filter spec: (out_channels, kernel, stride) per layer
+    _CONV_LAYERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
     # -- params --------------------------------------------------------------
     def init_params(self, key: jax.Array) -> Dict:
         s = self.spec
+        if s.conv:
+            return self._init_conv_params(key)
         dims = (s.observation_dim,) + s.hidden
         keys = jax.random.split(key, len(dims) + 2)
         torso = []
@@ -61,8 +70,61 @@ class RLModule:
             params["log_std"] = jnp.zeros((s.action_dim,))
         return params
 
+    def _init_conv_params(self, key: jax.Array) -> Dict:
+        """Nature-CNN torso (Mnih 2015): conv 32×8s4, 64×4s2, 64×3s1 →
+        dense(hidden[-1] or 512). Pixel math maps straight onto the MXU —
+        ``lax.conv_general_dilated`` in NHWC with f32 accumulation."""
+        s = self.spec
+        assert s.obs_shape is not None and len(s.obs_shape) == 3, s.obs_shape
+        keys = jax.random.split(key, len(self._CONV_LAYERS) + 3)
+        convs = []
+        c_in = s.obs_shape[-1]
+        hh, ww = s.obs_shape[0], s.obs_shape[1]
+        for i, (c_out, k, stride) in enumerate(self._CONV_LAYERS):
+            fan_in = k * k * c_in
+            convs.append({
+                "w": jax.random.normal(keys[i], (k, k, c_in, c_out))
+                * np.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((c_out,)),
+            })
+            hh = (hh - k) // stride + 1
+            ww = (ww - k) // stride + 1
+            c_in = c_out
+        flat = hh * ww * c_in
+        dense_out = s.hidden[-1] if s.hidden else 512
+        params = {
+            "convs": convs,
+            "dense": {
+                "w": jax.random.normal(keys[-3], (flat, dense_out))
+                * np.sqrt(2.0 / flat),
+                "b": jnp.zeros((dense_out,)),
+            },
+            "pi": {
+                "w": jax.random.normal(keys[-2], (dense_out, s.action_dim)) * 0.01,
+                "b": jnp.zeros((s.action_dim,)),
+            },
+            "vf": {
+                "w": jax.random.normal(keys[-1], (dense_out, 1)),
+                "b": jnp.zeros((1,)),
+            },
+        }
+        return params
+
     # -- forward passes ------------------------------------------------------
     def _torso(self, params: Dict, obs: jax.Array) -> jax.Array:
+        if self.spec.conv:
+            # uint8 pixels [B, H, W, C] (or pre-flattened) → [0, 1] floats.
+            s = self.spec
+            x = obs.reshape((-1,) + tuple(s.obs_shape)).astype(jnp.float32) / 255.0
+            for i, (_, _, stride) in enumerate(self._CONV_LAYERS):
+                layer = params["convs"][i]
+                x = jax.lax.conv_general_dilated(
+                    x, layer["w"], (stride, stride), "VALID",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                ) + layer["b"]
+                x = jax.nn.relu(x)
+            x = x.reshape(x.shape[0], -1)
+            return jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
         h = obs
         for layer in params["torso"]:
             h = jnp.tanh(h @ layer["w"] + layer["b"])
@@ -124,7 +186,8 @@ class RLModule:
 
 
 def spec_for_env(env) -> RLModuleSpec:
-    """Build a spec from a gymnasium env's spaces."""
+    """Build a spec from a gymnasium env's spaces. 3-D uint8 observation
+    spaces (Atari-style pixel stacks) get the conv torso automatically."""
     import gymnasium as gym
 
     obs_space = env.observation_space
@@ -133,8 +196,16 @@ def spec_for_env(env) -> RLModuleSpec:
         obs_dim = int(np.prod(obs_space.shape))
     else:
         obs_dim = obs_space.n
+    conv = (getattr(obs_space, "shape", None) is not None
+            and len(obs_space.shape) == 3
+            and getattr(obs_space, "dtype", None) == np.uint8)
+    obs_shape = tuple(obs_space.shape) if conv else None
     if isinstance(act_space, gym.spaces.Discrete):
-        return RLModuleSpec(observation_dim=obs_dim, action_dim=int(act_space.n), discrete=True)
+        return RLModuleSpec(observation_dim=obs_dim, action_dim=int(act_space.n),
+                            discrete=True, conv=conv, obs_shape=obs_shape,
+                            hidden=(512,) if conv else (64, 64))
     return RLModuleSpec(
-        observation_dim=obs_dim, action_dim=int(np.prod(act_space.shape)), discrete=False
+        observation_dim=obs_dim, action_dim=int(np.prod(act_space.shape)),
+        discrete=False, conv=conv, obs_shape=obs_shape,
+        hidden=(512,) if conv else (64, 64),
     )

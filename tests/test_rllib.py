@@ -251,3 +251,160 @@ class TestPPOE2E:
         for a, b in zip(jax.tree.leaves(w_before), jax.tree.leaves(w_after)):
             np.testing.assert_array_equal(a, b)
         algo.stop()
+
+
+class TestVtrace:
+    def test_vtrace_matches_naive_reference(self):
+        """Scan-based V-trace vs a direct O(T^2) transcription of Espeholt
+        et al. eq. 1."""
+        import numpy as np
+        from ray_tpu.rllib.impala import vtrace
+
+        rng = np.random.default_rng(0)
+        T, N = 7, 3
+        gamma, rho_bar, c_bar = 0.95, 1.0, 1.0
+        b_logp = rng.normal(0, 0.3, (T, N)).astype(np.float32)
+        t_logp = rng.normal(0, 0.3, (T, N)).astype(np.float32)
+        rewards = rng.normal(0, 1, (T, N)).astype(np.float32)
+        values = rng.normal(0, 1, (T, N)).astype(np.float32)
+        bootstrap = rng.normal(0, 1, N).astype(np.float32)
+        dones = (rng.random((T, N)) < 0.2).astype(np.float32)
+
+        vs, pg_adv = vtrace(b_logp, t_logp, rewards, values, bootstrap,
+                            dones, gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+
+        # naive: vs_t = V_t + sum_{k>=t} (prod_{i=t..k-1} disc_i c_i) delta_k
+        rho = np.minimum(rho_bar, np.exp(t_logp - b_logp))
+        c = np.minimum(c_bar, np.exp(t_logp - b_logp))
+        disc = gamma * (1 - dones)
+        nv = np.concatenate([values[1:], bootstrap[None]], axis=0)
+        deltas = rho * (rewards + disc * nv - values)
+        vs_naive = values.copy()
+        for t in range(T):
+            for k in range(t, T):
+                coef = np.ones(N, np.float32)
+                for i in range(t, k):
+                    coef *= disc[i] * c[i]
+                vs_naive[t] += coef * deltas[k]
+        np.testing.assert_allclose(np.asarray(vs), vs_naive, rtol=1e-4, atol=1e-4)
+        vs_next = np.concatenate([np.asarray(vs)[1:], bootstrap[None]], axis=0)
+        adv_naive = rho * (rewards + disc * vs_next - values)
+        np.testing.assert_allclose(np.asarray(pg_adv), adv_naive, rtol=1e-4, atol=1e-4)
+
+    def test_vtrace_on_policy_reduces_to_discounted_returns(self):
+        """With pi == mu and lambda-free targets, vs equals the n-step
+        discounted return (no clipping active)."""
+        import numpy as np
+        from ray_tpu.rllib.impala import vtrace
+
+        T, N = 5, 2
+        logp = np.zeros((T, N), np.float32)
+        rewards = np.ones((T, N), np.float32)
+        values = np.zeros((T, N), np.float32)
+        bootstrap = np.zeros(N, np.float32)
+        dones = np.zeros((T, N), np.float32)
+        vs, _ = vtrace(logp, logp, rewards, values, bootstrap, dones,
+                       gamma=0.9)
+        expect = np.array([sum(0.9 ** (k - t) for k in range(t, T))
+                           for t in range(T)], np.float32)
+        np.testing.assert_allclose(np.asarray(vs)[:, 0], expect, rtol=1e-5)
+
+
+class TestConvModule:
+    def test_conv_forward_shapes_and_grad(self):
+        import numpy as np
+        import jax
+        from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+        spec = RLModuleSpec(observation_dim=84 * 84 * 4, action_dim=6,
+                            discrete=True, conv=True, obs_shape=(84, 84, 4),
+                            hidden=(512,))
+        mod = RLModule(spec)
+        params = mod.init_params(jax.random.key(0))
+        obs = np.random.default_rng(0).integers(
+            0, 255, (3, 84 * 84 * 4)).astype(np.float32)
+        out = mod.forward_train(params, obs)
+        assert out["action_dist_inputs"].shape == (3, 6)
+        assert out["vf_preds"].shape == (3,)
+        logp, ent, v = mod.logp_and_entropy(params, obs, np.array([0, 2, 5]))
+        assert logp.shape == (3,)
+
+    def test_spec_for_env_detects_pixels(self):
+        from ray_tpu.rllib.envs import SyntheticAtariEnv
+        from ray_tpu.rllib.rl_module import spec_for_env
+
+        env = SyntheticAtariEnv()
+        spec = spec_for_env(env)
+        assert spec.conv and spec.obs_shape == (84, 84, 4)
+        assert spec.action_dim == 6
+
+
+class TestImpala:
+    def test_impala_learns_cartpole(self, ray_start_regular):
+        """Async IMPALA improves CartPole return (learning smoke gate)."""
+        import gymnasium as gym
+        import numpy as np
+        from ray_tpu.rllib.impala import ImpalaConfig
+
+        algo = (
+            ImpalaConfig()
+            .environment(lambda: gym.make("CartPole-v1"))
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4)
+            .training(rollout_fragment_length=64, lr=5e-3,
+                      broadcast_interval=1)
+            .build()
+        )
+        try:
+            first = None
+            best = -np.inf
+            for i in range(12):
+                result = algo.train()
+                r = result["episode_return_mean"]
+                if not np.isnan(r):
+                    first = r if first is None else first
+                    best = max(best, r)
+            assert first is not None, "no episodes completed"
+            assert best > max(first * 1.3, 40.0), (first, best)
+        finally:
+            algo.stop()
+
+    def test_impala_with_aggregators(self, ray_start_regular):
+        import gymnasium as gym
+        from ray_tpu.rllib.impala import ImpalaConfig
+
+        algo = (
+            ImpalaConfig()
+            .environment(lambda: gym.make("CartPole-v1"))
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2)
+            .training(rollout_fragment_length=32, num_aggregators=1,
+                      train_batch_fragments=2)
+            .build()
+        )
+        try:
+            result = algo.train()
+            assert result["num_updates"] >= 1
+            assert result["timesteps_total"] > 0
+        finally:
+            algo.stop()
+
+
+class TestSyntheticAtariPPO:
+    def test_ppo_runs_on_pixels(self, ray_start_regular):
+        """Conv PPO end-to-end on the Atari stand-in (throughput > 0)."""
+        from ray_tpu.rllib.envs import SyntheticAtariEnv
+        from ray_tpu.rllib.ppo import PPOConfig
+
+        algo = (
+            PPOConfig()
+            .environment(lambda: SyntheticAtariEnv(max_steps=200))
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=2)
+            .training(rollout_fragment_length=16, num_epochs=1,
+                      minibatch_size=16, hidden=())
+            .build()
+        )
+        try:
+            result = algo.train()
+            assert result["env_steps_per_sec"] > 0
+            assert np.isfinite(result["loss"])
+        finally:
+            algo.stop()
