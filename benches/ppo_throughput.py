@@ -97,12 +97,15 @@ def main():
 
 
 def _is_ale() -> bool:
-    try:
-        import ale_py  # noqa: F401
+    # Label by what make_atari ACTUALLY builds (it falls back to the
+    # synthetic env on missing ROMs, not just missing packages).
+    from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
 
-        return True
-    except ImportError:
-        return False
+    probe = make_atari()
+    try:
+        return not isinstance(probe, SyntheticAtariEnv)
+    finally:
+        probe.close()
 
 
 if __name__ == "__main__":

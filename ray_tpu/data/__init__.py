@@ -18,6 +18,13 @@ from ray_tpu.data.dataset import (
     read_numpy,
     read_parquet,
 )
+from ray_tpu.data.datasources import (
+    read_binary_files,
+    read_images,
+    read_text,
+    read_tfrecords,
+    write_tfrecords,
+)
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
@@ -35,4 +42,9 @@ __all__ = [
     "read_csv",
     "read_json",
     "read_numpy",
+    "read_text",
+    "read_binary_files",
+    "read_images",
+    "read_tfrecords",
+    "write_tfrecords",
 ]

@@ -52,9 +52,15 @@ class BlockAccessor:
         for k, v in data.items():
             v = np.asarray(v)
             if v.ndim > 1:
-                # tensor column: fixed-shape lists (reference: ArrowTensorArray)
-                flat = pa.array(v.reshape(v.shape[0], -1).tolist())
-                cols[k] = flat
+                # Tensor column with shape preserved in the schema
+                # (reference: ArrowTensorArray extension type). pyarrow
+                # rejects degenerate strides (e.g. the 0-stride leading axis
+                # of arr[None, ...] views), which ascontiguousarray does NOT
+                # normalize for size-1 dims — copy restores standard strides.
+                v = np.ascontiguousarray(v)
+                if v.strides[0] < max(v.strides):
+                    v = v.copy()
+                cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(v)
             else:
                 cols[k] = pa.array(v)
         return pa.table(cols)
@@ -102,14 +108,25 @@ class BlockAccessor:
 
     def _column_to_numpy(self, name: str) -> np.ndarray:
         col = self._table.column(name)
+        if isinstance(col.type, pa.FixedShapeTensorType):
+            return col.combine_chunks().to_numpy_ndarray()
         try:
             return col.to_numpy(zero_copy_only=False)
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
             return np.asarray(col.to_pylist())
 
     def iter_rows(self) -> Iterable[Row]:
+        # to_pylist flattens tensor-extension columns; restore their shapes.
+        tensor_shapes = {
+            f.name: tuple(f.type.shape)
+            for f in self._table.schema
+            if isinstance(f.type, pa.FixedShapeTensorType)
+        }
         for batch in self._table.to_batches():
             for row in batch.to_pylist():
+                for name, shape in tensor_shapes.items():
+                    if row.get(name) is not None:
+                        row[name] = np.asarray(row[name]).reshape(shape)
                 yield row
 
     def select(self, columns: List[str]) -> Block:

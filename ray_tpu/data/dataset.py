@@ -153,43 +153,50 @@ class Dataset:
 
     # ------------------------------------------------------------ all-to-all
     def repartition(self, num_blocks: int) -> "Dataset":
+        """Order-preserving push-based exchange: a metadata pass computes
+        global row offsets, mappers slice each block against the global
+        output boundaries, and partials merge in input order — rows keep
+        their global order (``data/shuffle.py``)."""
+
         def do(all_refs: List[Any]) -> List[Any]:
-            blocks = [ray_tpu.get(r) for r in all_refs]
-            table = BlockAccessor.concat(blocks)
-            n = max(1, num_blocks)
-            rows = table.num_rows
-            out = []
-            for i in builtins.range(n):
-                lo = i * rows // n
-                hi = (i + 1) * rows // n
-                out.append(ray_tpu.put(table.slice(lo, hi - lo)))
-            return out
+            from ray_tpu.data.shuffle import (
+                block_num_rows,
+                push_based_shuffle,
+                repartition_map_split,
+            )
+
+            count_remote = ray_tpu.remote(block_num_rows)
+            counts = ray_tpu.get([count_remote.remote(r) for r in all_refs])
+            total = sum(counts)
+            P = max(1, num_blocks)
+            bounds = [p * total // P for p in builtins.range(P + 1)]
+            offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            return push_based_shuffle(
+                all_refs, num_partitions=P, map_fn=repartition_map_split,
+                map_args=[(int(o), bounds) for o in offsets],
+            )
 
         return self._append(AllToAll(self._plan.dag, do, "Repartition"))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Two-stage map/reduce exchange (reference:
-        ``_internal/planner/exchange/pull_based_shuffle_task_scheduler.py``):
-        stage 1 splits every block into P random partitions; stage 2 reduces
-        partition i across all maps into one output block."""
+        """Push-based shuffle exchange (reference:
+        ``_internal/planner/exchange/push_based_shuffle_task_scheduler.py``):
+        mappers random-partition each block; partials merge into the running
+        reducer state round by round, so peak reducer memory is one merged
+        block + one round of partials — not all M map outputs at once."""
 
         def do(all_refs: List[Any]) -> List[Any]:
-            P = max(1, len(all_refs))
-            split_remote = ray_tpu.remote(_shuffle_split).options(num_returns=P)
-            reduce_remote = ray_tpu.remote(_shuffle_reduce)
-            parts: List[List[Any]] = [[] for _ in builtins.range(P)]
-            for i, ref in enumerate(all_refs):
-                s = seed + i if seed is not None else None
-                refs = split_remote.remote(ref, P, s)
-                if P == 1:
-                    refs = [refs]
-                for p, pref in enumerate(refs):
-                    parts[p].append(pref)
-            rs = seed
-            return [
-                reduce_remote.remote(None if rs is None else rs + p, *parts[p])
-                for p in builtins.range(P)
-            ]
+            from ray_tpu.data.shuffle import (
+                _merge_and_permute,
+                push_based_shuffle,
+                shuffle_map_split,
+            )
+
+            return push_based_shuffle(
+                all_refs, num_partitions=max(1, len(all_refs)),
+                map_fn=shuffle_map_split, final_fn=_merge_and_permute,
+                seed=seed,
+            )
 
         return self._append(AllToAll(self._plan.dag, do, "RandomShuffle"))
 
@@ -400,21 +407,6 @@ def _format_batch(block: Block, batch_format: str):
     if batch_format in ("pyarrow", "arrow"):
         return block
     raise ValueError(f"unknown batch_format {batch_format}")
-
-
-def _shuffle_split(block: Block, num_parts: int, seed: Optional[int]):
-    acc = BlockAccessor(block)
-    rng = np.random.default_rng(seed)
-    assignment = rng.integers(0, num_parts, acc.num_rows())
-    parts = [acc.take(list(np.nonzero(assignment == p)[0])) for p in builtins.range(num_parts)]
-    return tuple(parts) if num_parts > 1 else parts[0]
-
-
-def _shuffle_reduce(seed: Optional[int], *parts: Block) -> Block:
-    table = BlockAccessor.concat(list(parts))
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(table.num_rows)
-    return BlockAccessor(table).take(list(perm))
 
 
 class GroupedData:

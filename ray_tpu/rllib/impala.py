@@ -249,15 +249,22 @@ class IMPALA:
             consumed += 1
             T, N = cfg.rollout_fragment_length, cfg.num_envs_per_runner
             sampled_steps += T * N
-            if self._aggregators:
+            if cfg.train_batch_fragments > 1:
                 self._pending_frags.append(ref)
                 if len(self._pending_frags) < cfg.train_batch_fragments:
                     continue
-                agg = self._aggregators[self._agg_rr % len(self._aggregators)]
-                self._agg_rr += 1
-                batch_ref = agg.aggregate.remote(*self._pending_frags)
+                if self._aggregators:
+                    agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+                    self._agg_rr += 1
+                    batch_ref = agg.aggregate.remote(*self._pending_frags)
+                    batch = self._to_train_batch(ray_tpu.get(batch_ref))
+                else:
+                    # No aggregator actors: concatenate on the driver so the
+                    # configured batch size still holds.
+                    frags = ray_tpu.get(self._pending_frags)
+                    batch = self._to_train_batch(
+                        AggregatorActor().aggregate(*frags))
                 self._pending_frags = []
-                batch = self._to_train_batch(ray_tpu.get(batch_ref))
             else:
                 batch = self._to_train_batch(ray_tpu.get(ref))
             losses.append(self.learner.update(batch)["loss"])
