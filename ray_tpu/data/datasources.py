@@ -46,7 +46,15 @@ def _crc32c_table() -> List[int]:
     return _CRC_TABLE
 
 
+try:  # accelerated CRC when a native wheel is present
+    import google_crc32c as _gcrc
+except ImportError:
+    _gcrc = None
+
+
 def crc32c(data: bytes) -> int:
+    if _gcrc is not None:
+        return _gcrc.value(bytes(data))
     table = _crc32c_table()
     crc = 0xFFFFFFFF
     for b in data:
@@ -130,7 +138,7 @@ def read_text(paths: Union[str, List[str]], *, encoding: str = "utf-8") -> Datas
     def make_task(f: str):
         def read():
             with open(f, encoding=encoding) as fh:
-                lines = [line.rstrip("\n") for line in fh]
+                lines = [line.rstrip("\r\n") for line in fh]
             return pa.table({"text": pa.array(lines, pa.string())})
 
         return read

@@ -114,6 +114,8 @@ class TuneController:
         max_concurrent: Optional[int] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         searcher=None,
+        experiment_state=None,  # ExperimentState for periodic snapshots
+        experiment_meta: Optional[Dict[str, Any]] = None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -131,6 +133,8 @@ class TuneController:
         self._runners: Dict[str, Any] = {}
         self._run_refs: Dict[str, Any] = {}
         self._collector = None
+        self._exp_state = experiment_state
+        self._exp_meta = experiment_meta or {}
 
     # -- helpers -------------------------------------------------------------
     def _launch(self, trial: Trial) -> None:
@@ -174,7 +178,10 @@ class TuneController:
         collector_cls = ray_tpu.remote(_TuneCollectorImpl)
         self._collector = collector_cls.options(num_cpus=0).remote()
         by_id = {t.trial_id: t for t in self.trials}
-        pending = list(self.trials)
+        # Resume support: already-finished trials (from a restored
+        # experiment) never relaunch; interrupted ones carry their
+        # restore_checkpoint (experiment_state.py).
+        pending = [t for t in self.trials if not t.is_finished()]
         restarting: List[Trial] = []
 
         while True:
@@ -236,9 +243,19 @@ class TuneController:
                         self.searcher.on_trial_complete(trial_id, result=trial.last_result)
                     self.scheduler.on_trial_complete(trial, trial.last_result)
 
+            if self._exp_state is not None:
+                # Completion events always persist immediately (a throttled
+                # snapshot losing a TERMINATED status would rerun the trial
+                # on restore); mid-trial progress is throttled.
+                self._exp_state.maybe_snapshot(self.trials, self._exp_meta,
+                                               force=bool(done))
+
             if not results and not done:
                 time.sleep(0.02)
 
+        if self._exp_state is not None:
+            self._exp_state.maybe_snapshot(self.trials, self._exp_meta,
+                                           force=True)
         try:
             ray_tpu.kill(self._collector)
         except Exception:

@@ -103,27 +103,106 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
+        self._restored_trials: Optional[List[Trial]] = None
+
+    def _experiment_path(self) -> Optional[str]:
+        if not self.run_config.storage_path:
+            return None
+        import os
+
+        name = self.run_config.name or "tune_experiment"
+        return os.path.join(self.run_config.storage_path, name)
+
+    # -- persistence / resume (tune/execution/experiment_state.py) -----------
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        from ray_tpu.tune.experiment_state import ExperimentState
+
+        return ExperimentState.exists(path)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable | Any = None) -> "Tuner":
+        """Rebuild a Tuner from ``<storage_path>/<name>`` after a crash.
+
+        Finished trials keep their results; interrupted (RUNNING) trials
+        resume from their latest checkpoint; pending ones run fresh. Pass
+        ``trainable`` to override the pickled one (the reference requires
+        re-passing it too when it wasn't serializable).
+        """
+        import os
+
+        from ray_tpu.tune.experiment_state import ExperimentState
+
+        data = ExperimentState.load(path)
+        meta = data["meta"]
+        if trainable is None:
+            trainable = meta.get("trainable")
+        if trainable is None:
+            raise ValueError(
+                "the original trainable was not serializable into the "
+                "experiment snapshot — pass it explicitly: "
+                "Tuner.restore(path, trainable=...)")
+        if trainable is not None and hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        tuner = cls(
+            trainable,
+            param_space=meta.get("param_space"),
+            tune_config=meta.get("tune_config") or TuneConfig(),
+            run_config=RunConfig(
+                name=os.path.basename(path),
+                storage_path=os.path.dirname(path),
+            ),
+            resources_per_trial=meta.get("resources_per_trial"),
+        )
+        tuner._restored_trials = data["trials"]
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         searcher = tc.search_alg
-        if searcher is None:
-            searcher = BasicVariantGenerator(self.param_space, num_samples=tc.num_samples)
-            n_trials = searcher.total_variants
+        if self._restored_trials is not None:
+            trials = self._restored_trials
         else:
-            n_trials = tc.num_samples
-        if searcher.metric is None:
-            searcher.metric = tc.metric
-            searcher.mode = tc.mode
+            if searcher is None:
+                searcher = BasicVariantGenerator(self.param_space, num_samples=tc.num_samples)
+                n_trials = searcher.total_variants
+            else:
+                n_trials = tc.num_samples
+            if searcher.metric is None:
+                searcher.metric = tc.metric
+                searcher.mode = tc.mode
 
-        trials = []
-        for _ in range(n_trials):
-            t = Trial(config={})
-            cfg = searcher.suggest(t.trial_id)
-            if cfg is None:
-                break
-            t.config = cfg
-            trials.append(t)
+            trials = []
+            for _ in range(n_trials):
+                t = Trial(config={})
+                cfg = searcher.suggest(t.trial_id)
+                if cfg is None:
+                    break
+                t.config = cfg
+                trials.append(t)
+
+        exp_state = None
+        exp_meta = {}
+        exp_path = self._experiment_path()
+        if exp_path is not None:
+            from ray_tpu.tune.experiment_state import ExperimentState
+
+            exp_state = ExperimentState(exp_path)
+            try:
+                import cloudpickle
+
+                cloudpickle.dumps(self.trainable)
+                trainable_meta = self.trainable
+            except Exception:  # noqa: BLE001 — restore() must re-pass it
+                trainable_meta = None
+            exp_meta = {
+                "trainable": trainable_meta,
+                "param_space": self.param_space,
+                "tune_config": tc,
+                "resources_per_trial": self.resources_per_trial,
+            }
+            exp_state.maybe_snapshot(trials, exp_meta, force=True)
 
         controller = TuneController(
             self.trainable,
@@ -134,6 +213,8 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             resources_per_trial=self.resources_per_trial,
             searcher=searcher if not isinstance(searcher, BasicVariantGenerator) else None,
+            experiment_state=exp_state,
+            experiment_meta=exp_meta,
         )
         controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
