@@ -570,9 +570,13 @@ class CoreWorker:
                         TaskError(spec.function_name,
                                   f"GCS unreachable: {e}", None))
                     return
+                renv = spec.options.runtime_env
+                env_vars = (dict(renv["env_vars"])
+                            if renv and renv.get("env_vars") else None)
                 try:
                     result = self._daemons.get(node_addr).call(
-                        "execute_task", spec_bytes, lease_id, timeout=None
+                        "execute_task", spec_bytes, lease_id, env_vars,
+                        timeout=None,
                     )
                 except Exception as e:  # noqa: BLE001
                     retriable = isinstance(e, RpcConnectionError) or (

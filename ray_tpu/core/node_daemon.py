@@ -46,15 +46,18 @@ from ray_tpu.core.exceptions import WorkerDiedError
 
 
 class _Worker:
-    __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy")
+    __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy",
+                 "env_key")
 
-    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen):
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen,
+                 env_key: Optional[str] = None):
         self.worker_id = worker_id
         self.proc = proc
         self.address: Optional[str] = None
         self.client: Optional[RpcClient] = None
         self.actor_id: Optional[ActorID] = None  # dedicated to an actor
         self.busy = False
+        self.env_key = env_key  # runtime_env hash; None = vanilla pool
 
 
 class NodeDaemon:
@@ -174,7 +177,8 @@ class NodeDaemon:
 
     # ====================== worker pool ======================
 
-    def _spawn_worker(self) -> _Worker:
+    def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
+                      env_key: Optional[str] = None) -> _Worker:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
@@ -182,24 +186,69 @@ class NodeDaemon:
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_STORE_NAME"] = self.store_name
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
         )
-        worker = _Worker(worker_id, proc)
+        worker = _Worker(worker_id, proc, env_key=env_key)
         self._workers[worker_id] = worker
         return worker
+
+    def _spawn_dedicated(self, env_vars: Dict[str, str],
+                         timeout: float = 60.0) -> _Worker:
+        """Fresh worker with a per-task/actor runtime environment.
+
+        The reference keys its idle pool by runtime-env hash
+        (worker_pool.cc); here env-bearing workers never join the vanilla
+        pool at all — they are dedicated (actors) or killed after the task.
+        env_vars apply at PROCESS SPAWN, so they land before any import
+        (including sitecustomize-preloaded jax) runs in the worker.
+        """
+        import json
+
+        key = json.dumps(env_vars, sort_keys=True)
+        deadline = time.time() + timeout
+        with self._pool_cv:
+            # Dedicated spawns don't touch _spawn_pending: that counter
+            # gates the VANILLA pool only (a stuck dedicated spawn must not
+            # starve ordinary tasks).
+            worker = self._spawn_worker(env_vars, env_key=key)
+            try:
+                while worker.address is None:
+                    if worker.proc.poll() is not None:
+                        raise WorkerDiedError(
+                            "runtime_env worker exited during startup "
+                            f"rc={worker.proc.returncode}")
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError("runtime_env worker failed to start")
+                    self._pool_cv.wait(timeout=min(remaining, 1.0))
+            except (TimeoutError, WorkerDiedError):
+                self._workers.pop(worker.worker_id, None)
+                try:
+                    worker.proc.kill()
+                except OSError:
+                    pass
+                raise
+            worker.busy = True
+            return worker
 
     def register_worker(self, worker_id: WorkerID, address: str) -> None:
         """Called by a freshly started worker process once its server is up."""
         with self._pool_cv:
-            self._spawn_pending = max(0, self._spawn_pending - 1)
             worker = self._workers.get(worker_id)
             if worker is None:
                 return
             worker.address = address
             worker.client = RpcClient(address)
-            self._idle.append(worker)
+            if worker.env_key is None:
+                # Only vanilla workers join the shared idle pool; dedicated
+                # (runtime_env) workers are claimed by their spawner via the
+                # address becoming non-None — never by _pop_worker.
+                self._spawn_pending = max(0, self._spawn_pending - 1)
+                self._idle.append(worker)
             self._pool_cv.notify_all()
 
     def _pop_worker(self, timeout: float = 60.0) -> _Worker:
@@ -233,6 +282,13 @@ class NodeDaemon:
                 self._demand -= 1
 
     def _return_worker(self, worker: _Worker) -> None:
+        if worker.env_key is not None:
+            # Env-contaminated worker: never rejoins the vanilla pool.
+            try:
+                worker.proc.kill()
+            except OSError:
+                pass
+            return
         with self._pool_cv:
             if (worker.proc.poll() is None and worker.actor_id is None
                     and worker.worker_id in self._workers):
@@ -274,17 +330,21 @@ class NodeDaemon:
 
     # ====================== task execution ======================
 
-    def execute_task(self, spec_bytes: bytes, lease_id: str) -> dict:
+    def execute_task(self, spec_bytes: bytes, lease_id: str,
+                     env_vars: Optional[Dict[str, str]] = None) -> dict:
         """Run one task on a pooled worker; returns the worker's result meta.
 
         The reference pushes tasks from the *driver* straight to the leased
         worker (``direct_task_transport.cc:241 PushNormalTask``); we route
         through the daemon so worker identity stays private to the node and
         worker death maps cleanly to a retriable error for the caller.
+        ``env_vars`` (the spec's runtime_env, sent as a sidecar so the
+        daemon never deserializes user args) forces a fresh worker process.
         """
         try:
-            worker = self._pop_worker()
-        except TimeoutError as e:
+            worker = (self._spawn_dedicated(env_vars) if env_vars
+                      else self._pop_worker())
+        except BaseException as e:  # noqa: BLE001 — lease must not leak
             self._release(lease_id)
             raise WorkerDiedError(f"worker pool exhausted: {e}") from e
         broken = False
@@ -322,8 +382,21 @@ class NodeDaemon:
 
         The lease is held for the actor's lifetime (its resources stay
         allocated), released when the worker dies or the actor is killed.
+        Actors with ``runtime_env={"env_vars": ...}`` get a FRESH process
+        with those vars applied at spawn (the reference's runtime-env agent
+        path; env must precede interpreter-level imports).
         """
-        worker = self._pop_worker()
+        from ray_tpu.core import serialization
+
+        spec = serialization.loads(spec_bytes)
+        renv = spec.options.runtime_env
+        env_vars = dict(renv["env_vars"]) if renv and renv.get("env_vars") else None
+        try:
+            worker = (self._spawn_dedicated(env_vars) if env_vars
+                      else self._pop_worker())
+        except BaseException as e:  # noqa: BLE001 — lease must not leak
+            self._release(lease_id)
+            raise WorkerDiedError(f"actor worker spawn failed: {e}") from e
         try:
             worker.client.call("start_actor", spec_bytes, timeout=None)
         except RpcConnectionError as e:
@@ -337,9 +410,6 @@ class NodeDaemon:
             self._release(lease_id)
             self._return_worker(worker)
             raise
-        from ray_tpu.core import serialization
-
-        spec = serialization.loads(spec_bytes)
         with self._pool_lock:
             worker.actor_id = spec.actor_id
             self._actor_records[spec.actor_id] = (spec_bytes, worker.address)

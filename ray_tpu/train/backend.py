@@ -50,7 +50,9 @@ class Backend:
 class JaxConfig(BackendConfig):
     """TPU/JAX backend config.
 
-    coordinator_port: port for jax.distributed's coordination service.
+    coordinator_port: port for jax.distributed's coordination service;
+        0 (default) reserves a free port on rank 0's host at start. Set a
+        fixed port when inter-host firewalls require one.
     init_distributed: force-enable/disable ``jax.distributed.initialize``
         (default: only when the group spans >1 process/host).
     collective_group: also register an eager (host-side) collective group for
@@ -59,12 +61,22 @@ class JaxConfig(BackendConfig):
         device tensors always go through XLA collectives inside jit.
     """
 
-    coordinator_port: int = 8476
+    coordinator_port: int = 0  # 0 = reserve a free port on rank 0
     init_distributed: Optional[bool] = None
     collective_group: Optional[str] = "train"
 
     def backend_cls(self):
         return _JaxBackend
+
+
+def _reserve_free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _setup_jax_worker(coordinator: str, num_processes: int, process_id: int, enable: bool):
@@ -96,7 +108,17 @@ class _JaxBackend(Backend):
             if backend_config.init_distributed is not None
             else multiproc
         )
-        coordinator = f"{worker_group.metadatas[0].hostname}:{backend_config.coordinator_port}"
+        import ray_tpu
+
+        port = backend_config.coordinator_port
+        if enable and not port:
+            # Reserve a free port ON RANK 0's host so parallel worker groups
+            # (or a stale coordination service) can't collide; the address
+            # then flows to every worker through the control plane. A
+            # user-fixed port (firewalls) is honored as-is.
+            port = ray_tpu.get(worker_group.execute_single_async(
+                0, _reserve_free_port))
+        coordinator = f"{worker_group.metadatas[0].hostname}:{port}"
         worker_group.execute(
             lambda rank=None: None
         )  # barrier: all actors constructed
@@ -106,8 +128,6 @@ class _JaxBackend(Backend):
             )
             for i in range(worker_group.num_workers)
         ]
-        import ray_tpu
-
         ray_tpu.get(results)
 
         if backend_config.collective_group:

@@ -97,7 +97,10 @@ class ExperimentState:
         if not self._meta_written:
             self._write(self.meta_file, cloudpickle.dumps(meta))
             self._meta_written = True
-        self._write(self.file, pickle.dumps({
+        # cloudpickle here too: trial CONFIGS may hold lambdas/local
+        # callables (sample_from, grid over functions) that plain pickle
+        # rejects — the snapshot must never crash the experiment.
+        self._write(self.file, cloudpickle.dumps({
             "trials": [_trial_to_dict(t) for t in trials],
             "timestamp": now,
         }))

@@ -134,6 +134,7 @@ class Tuner:
 
         from ray_tpu.tune.experiment_state import ExperimentState
 
+        path = os.path.normpath(path)  # trailing slash would split wrong
         data = ExperimentState.load(path)
         meta = data["meta"]
         if trainable is None:

@@ -1,0 +1,189 @@
+"""Multi-host Train: a global JAX mesh across real worker PROCESSES.
+
+The seam the reference leaves to torch (``train/torch/config.py:64-100``
+NCCL process groups) done TPU-natively: two worker processes on the
+multiprocess cluster each own 4 virtual CPU devices, form ONE 8-device
+global mesh via ``jax.distributed`` (coordinator address flowing through
+the GCS KV — the control plane), and run the full sharded GPT-2-tiny train
+step with data parallelism across the process boundary. Losses over two
+steps must match a single-process 8-device oracle, which also proves the
+gradient psum crossed processes correctly (step 2's loss depends on step
+1's update).
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster, connect
+from ray_tpu.core import runtime as runtime_mod
+
+# Worker processes cannot import the tests package — ship this module's
+# classes by value (what cloudpickle does automatically for __main__).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+VOCAB, SEQ, GLOBAL_BATCH = 256, 64, 8
+
+
+class TrainWorker:
+    """One per-host training process (4 local devices, rank in a world of 2).
+
+    Device-count/platform env arrives via ``runtime_env={"env_vars": ...}``
+    — applied by the node daemon at process SPAWN, before the interpreter's
+    sitecustomize can preload jax with the wrong config.
+    """
+
+    def __init__(self, rank: int, world: int):
+        self.rank = rank
+        self.world = world
+
+    def reserve_coordinator(self) -> str:
+        """Rank 0: pick a free port; the driver publishes it via GCS KV."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"127.0.0.1:{port}"
+
+    def init_distributed(self, coordinator: str) -> int:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=self.world,
+            process_id=self.rank,
+        )
+        jax.config.update("jax_default_matmul_precision", "highest")
+        return len(jax.devices())  # global device count
+
+    def train_two_steps(self, tokens_local: np.ndarray):
+        """Run two sharded train steps; returns both global losses."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import transformer
+        from ray_tpu.models.training import make_train_step
+        from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+        from ray_tpu.parallel.sharding import ShardingRules
+
+        mesh = make_mesh(MeshSpec(data=-1), devices=jax.devices())
+        rules = ShardingRules()
+        cfg = transformer.tiny(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=SEQ, vocab_multiple=128, attn_impl="dense",
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        bundle = make_train_step(
+            loss_fn=lambda p, b: transformer.lm_loss(p, b, cfg, mesh=mesh, rules=rules),
+            init_params_fn=lambda k: transformer.init_params(cfg, k),
+            logical_params=transformer.logical_axes(cfg),
+            mesh=mesh, rules=rules,
+            optimizer=optax.adamw(1e-2),
+            batch_logical=("batch", None),
+        )
+        params, opt_state = bundle.init(jax.random.key(0))
+        # Each process contributes its local half of the global batch.
+        batch = {"tokens": jax.make_array_from_process_local_data(
+            bundle.batch_sharding, tokens_local)}
+        losses = []
+        for _ in range(2):
+            params, opt_state, metrics = bundle.step(params, opt_state, batch)
+            # loss is fully replicated — locally addressable on every process
+            losses.append(float(metrics["loss"]))
+        return losses
+
+
+def _oracle_two_steps(tokens_global: np.ndarray):
+    """Single-process 8-device oracle (the pytest process's CPU mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import transformer
+    from ray_tpu.models.training import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.parallel.sharding import ShardingRules
+
+    devices = jax.devices("cpu")
+    mesh = make_mesh(MeshSpec(data=-1), devices=devices)
+    rules = ShardingRules()
+    cfg = transformer.tiny(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=SEQ, vocab_multiple=128, attn_impl="dense",
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    bundle = make_train_step(
+        loss_fn=lambda p, b: transformer.lm_loss(p, b, cfg, mesh=mesh, rules=rules),
+        init_params_fn=lambda k: transformer.init_params(cfg, k),
+        logical_params=transformer.logical_axes(cfg),
+        mesh=mesh, rules=rules,
+        optimizer=optax.adamw(1e-2),
+        batch_logical=("batch", None),
+    )
+    params, opt_state = bundle.init(jax.random.key(0))
+    batch = {"tokens": jax.device_put(jnp.asarray(tokens_global),
+                                      bundle.batch_sharding)}
+    losses = []
+    for _ in range(2):
+        params, opt_state, metrics = bundle.step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def test_two_process_global_mesh_matches_oracle():
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, VOCAB, (GLOBAL_BATCH, SEQ)).astype(np.int32)
+
+    oracle = _oracle_two_steps(tokens)
+
+    cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 4})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            worker_cls = ray_tpu.remote(TrainWorker)
+            env_vars = {
+                "JAX_PLATFORMS": "cpu",
+                "JAX_NUM_CPU_DEVICES": "4",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                # Disable the axon sitecustomize's eager TPU-jax preload.
+                "PALLAS_AXON_POOL_IPS": "",
+            }
+            workers = [
+                worker_cls.options(
+                    num_cpus=2, runtime_env={"env_vars": env_vars}
+                ).remote(r, 2)
+                for r in range(2)
+            ]
+            # Coordinator address flows through the control plane: rank 0
+            # reserves it, the driver publishes to the GCS KV, rank 1 reads
+            # it back (the reference broadcasts rank 0's addr the same way).
+            coordinator = ray_tpu.get(workers[0].reserve_coordinator.remote(),
+                                      timeout=120)
+            core.gcs.kv_put("train/coordinator", coordinator.encode())
+            addr = core.gcs.kv_get("train/coordinator").decode()
+            # Both inits must be in flight together (the service blocks
+            # until every process connects).
+            counts = ray_tpu.get(
+                [w.init_distributed.remote(addr) for w in workers],
+                timeout=300)
+            assert counts == [8, 8], f"global mesh wrong: {counts}"
+
+            halves = [tokens[:GLOBAL_BATCH // 2], tokens[GLOBAL_BATCH // 2:]]
+            refs = [w.train_two_steps.remote(h)
+                    for w, h in zip(workers, halves)]
+            losses = ray_tpu.get(refs, timeout=300)
+            # Every process observed the same (replicated) global loss...
+            np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+            # ...and it matches the single-process oracle across BOTH steps
+            # (step 2 proves the cross-process gradient psum was applied).
+            np.testing.assert_allclose(losses[0], oracle, rtol=2e-4, atol=2e-4)
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()

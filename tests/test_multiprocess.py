@@ -519,3 +519,26 @@ def test_refcount_owner_free_protocol():
         assert freed == owned, (trial, freed, owned)
         # Never double-freed.
         assert len(core.freed) == len(freed)
+
+
+def test_runtime_env_env_vars(driver):
+    """Per-task/actor env_vars apply at worker process SPAWN (fresh process,
+    never returned to the vanilla pool)."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "hello-env"}})
+    def read_env():
+        import os
+
+        return os.environ.get("MY_FLAG"), os.getpid()
+
+    @ray_tpu.remote
+    def read_plain():
+        import os
+
+        return os.environ.get("MY_FLAG"), os.getpid()
+
+    val, env_pid = ray_tpu.get(read_env.remote(), timeout=120)
+    assert val == "hello-env"
+    val2, plain_pid = ray_tpu.get(read_plain.remote(), timeout=120)
+    assert val2 is None  # vanilla pool never contaminated
+    assert env_pid != plain_pid
