@@ -31,6 +31,15 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._method_name, args, kwargs, {})
 
+    def bind(self, upstream):
+        """Author a compiled-DAG stage (reference: ``dag_node.py`` bind API;
+        compile with ``.experimental_compile()``)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode
+
+        if not isinstance(upstream, DAGNode):
+            raise TypeError("bind() takes an InputNode or another DAG node")
+        return ClassMethodNode(self._handle, self._method_name, upstream)
+
     def options(self, **overrides):
         handle, name = self._handle, self._method_name
 

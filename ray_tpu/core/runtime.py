@@ -53,6 +53,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.core.scheduler import ClusterResourceScheduler
 from ray_tpu.core.task_spec import (
+    DAG_LOOP_METHOD,
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     TaskArg,
@@ -1041,7 +1042,7 @@ class Runtime:
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
-            method = getattr(runner.instance, spec.actor_method)
+            method = _resolve_actor_method(runner.instance, spec.actor_method)
             args, kwargs = self._fetch_args(spec)
             result = method(*args, **kwargs)
             self._store_results(state, result)
@@ -1069,6 +1070,11 @@ class Runtime:
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
+            if spec.actor_method == DAG_LOOP_METHOD:
+                # A resident blocking loop would freeze the actor's event
+                # loop (every queued coroutine starves) — reject clearly.
+                raise TypeError(
+                    "compiled DAGs are not supported on async actors")
             method = getattr(runner.instance, spec.actor_method)
             args, kwargs = self._fetch_args(spec)
             result = method(*args, **kwargs)
@@ -1159,6 +1165,18 @@ class Runtime:
             self.store.close()
         except Exception:
             pass
+
+
+def _resolve_actor_method(instance, method_name: str):
+    """Bind an actor method, routing DAG_LOOP_METHOD to the compiled-DAG
+    resident loop with the live instance (dag/compiled_dag.py)."""
+    if method_name == DAG_LOOP_METHOD:
+        import functools
+
+        from ray_tpu.dag.compiled_dag import actor_dag_loop
+
+        return functools.partial(actor_dag_loop, instance)
+    return getattr(instance, method_name)
 
 
 class _ActorCreationState(TaskState):

@@ -25,6 +25,13 @@ class TaskType(enum.Enum):
     ACTOR_TASK = 2
 
 
+# Reserved actor-method name dispatched to the compiled-DAG resident loop
+# (ray_tpu.dag.compiled_dag.actor_dag_loop) by BOTH runtimes' actor-task
+# executors. Lives here so the dispatchers and the dag package share one
+# definition without import cycles.
+DAG_LOOP_METHOD = "__ray_tpu_dag_loop__"
+
+
 @dataclass
 class TaskArg:
     """Either an inline (already serialized-with-the-spec) value or a ref."""

@@ -30,7 +30,7 @@ from ray_tpu.core.exceptions import ActorError, TaskCancelledError, TaskError
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcServer
-from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.core.task_spec import DAG_LOOP_METHOD, TaskSpec
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("worker")
@@ -219,7 +219,14 @@ class WorkerService:
                                  "actor not hosted by this worker"))
         self._admit_in_order(state, spec)
         try:
-            method = getattr(state.instance, spec.actor_method, None)
+            if spec.actor_method == DAG_LOOP_METHOD:
+                import functools
+
+                from ray_tpu.dag.compiled_dag import actor_dag_loop
+
+                method = functools.partial(actor_dag_loop, state.instance)
+            else:
+                method = getattr(state.instance, spec.actor_method, None)
             if method is None:
                 raise AttributeError(
                     f"actor {spec.function_name} has no method "

@@ -1,0 +1,22 @@
+"""ray_tpu.dag — compiled static actor DAGs (aDAG analog).
+
+Public surface mirrors ``python/ray/dag``: ``InputNode``, ``.bind()`` on
+actor methods, ``experimental_compile()`` → resident actor loops over
+mutable shm channels (same-host scope in v1; the reference's cross-node
+channel transport is a later extension).
+"""
+
+from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
+from ray_tpu.dag.compiled_dag import CompiledDAG, DAGRef
+from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
+
+__all__ = [
+    "InputNode",
+    "DAGNode",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGRef",
+    "Channel",
+    "ChannelClosed",
+    "ChannelTimeout",
+]

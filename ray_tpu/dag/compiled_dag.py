@@ -1,0 +1,149 @@
+"""Compiled DAG executor — resident actor loops over mutable channels.
+
+Analog of the reference's ``python/ray/dag/compiled_dag_node.py`` (625
+lines): compiling a static actor-method chain allocates one mutable channel
+per edge (``do_allocate_channel`` :28-39) and parks each actor in a resident
+read→exec→write loop (``do_exec_compiled_task`` :43-49); ``execute`` :532
+just writes the input channel. Per-call cost collapses from a full task
+submission (spec pickle → lease → push → result seal) to one shm write and
+one shm read per edge.
+
+TPU note: this is the host-side fast path the reference aims at GPU
+pipelines; on TPU the same shape feeds device steps whose tensors stay
+on-device between stages — the channels carry small host-side control
+payloads, not activations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from ray_tpu.core.task_spec import DAG_LOOP_METHOD
+from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
+
+
+def actor_dag_loop(instance, method_name: str, in_channel: Channel,
+                   out_channel: Channel) -> str:
+    """The resident loop body; runs INSIDE the actor (both runtimes hook
+    ``DAG_LOOP_METHOD`` to call this with the live instance)."""
+    method = getattr(instance, method_name)
+    while True:
+        try:
+            value = in_channel.read(timeout=None)
+        except ChannelClosed:
+            out_channel.close()
+            return "closed"
+        try:
+            result = method(value)
+        except Exception as exc:  # noqa: BLE001 — deliver to the caller
+            result = _DagError(f"{type(exc).__name__}: {exc}")
+        out_channel.write(result)
+
+
+class _DagError:
+    def __init__(self, message: str):
+        self.message = message
+
+
+class DAGRef:
+    """Future for one execute() call (reference returns a channel-backed
+    ref from CompiledDAG.execute the same way)."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+
+    def get(self, timeout: Optional[float] = 30.0):
+        return self._dag._fetch(self._index, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, leaf: DAGNode, *, channel_capacity: int = 4 * 1024 * 1024):
+        chain = leaf.chain()
+        if not chain or not isinstance(chain[0], InputNode):
+            raise ValueError("DAG must start from an InputNode")
+        stages = chain[1:]
+        if not stages or not all(isinstance(s, ClassMethodNode) for s in stages):
+            raise ValueError("DAG must be a chain of bound actor methods")
+        self._stages: List[ClassMethodNode] = stages
+        seen_actors = set()
+        for stage in stages:
+            aid = stage.actor.actor_id
+            if aid in seen_actors:
+                raise ValueError(
+                    "compiled DAG stages must use DISTINCT actors: the "
+                    "resident loop occupies an actor's execution thread, so "
+                    "a second stage on the same actor can never start")
+            seen_actors.add(aid)
+        # One channel per edge: input + one per stage output.
+        self._channels = [Channel(capacity=channel_capacity)
+                          for _ in range(len(stages) + 1)]
+        self._loop_refs = []
+        for i, stage in enumerate(stages):
+            # Park the actor in its resident loop (a long-running actor task
+            # that the runtimes route to actor_dag_loop with the instance).
+            ref = stage.actor._submit(
+                DAG_LOOP_METHOD,
+                (stage.method_name, self._channels[i], self._channels[i + 1]),
+                {}, {},
+            )
+            self._loop_refs.append(ref)
+        # Loop tasks run until teardown — one completing NOW means its
+        # startup failed (async actor, bad method, dead worker). Surface it
+        # here instead of as an opaque ChannelTimeout at execute().
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._loop_refs,
+                                num_returns=len(self._loop_refs), timeout=0.3)
+        if ready:
+            for ch in self._channels:
+                ch.destroy()
+            ray_tpu.get(ready[0])  # raises the loop's startup error
+            raise RuntimeError("DAG loop exited prematurely at compile time")
+        self._next_index = 0
+        self._reads = 0
+        self._fetched = {}
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._torn_down = False
+
+    def execute(self, value: Any) -> DAGRef:
+        """One DAG step: a single shm write; result via the returned ref.
+
+        Index assignment and the channel write share one lock: the input
+        channel is single-writer, and FIFO index↔result mapping requires
+        writes to land in index order. A failed (timed-out) write consumes
+        no index.
+        """
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        with self._write_lock:
+            self._channels[0].write(value)
+            index = self._next_index
+            self._next_index += 1
+        return DAGRef(self, index)
+
+    def _fetch(self, index: int, timeout: Optional[float]):
+        """Results arrive strictly FIFO on the output channel: the i-th read
+        is the i-th execute's result. The lock makes fetchers take turns
+        draining (single-reader channel contract)."""
+        with self._lock:
+            while index not in self._fetched:
+                out = self._channels[-1].read(timeout=timeout)
+                self._fetched[self._reads] = out
+                self._reads += 1
+            result = self._fetched.pop(index)
+        if isinstance(result, _DagError):
+            raise RuntimeError(f"DAG stage failed: {result.message}")
+        return result
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        # Poison the input; each stage forwards the close downstream.
+        self._channels[0].close()
+        for ch in self._channels:
+            ch.destroy()

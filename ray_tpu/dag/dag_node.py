@@ -1,0 +1,55 @@
+"""DAG authoring — the ``.bind()`` API.
+
+Analog of the reference's ``python/ray/dag/dag_node.py``: ``InputNode`` is
+the placeholder for per-call input; ``actor.method.bind(upstream)`` builds a
+``ClassMethodNode``. Only linear actor chains compile in v1 (the pipelined
+inference/training shape aDAG exists for); fan-out/multi-output is a later
+extension.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class DAGNode:
+    def __init__(self, upstream: Optional["DAGNode"]):
+        self.upstream = upstream
+
+    def chain(self) -> List["DAGNode"]:
+        """Nodes from InputNode to self, inclusive."""
+        nodes: List[DAGNode] = []
+        node: Optional[DAGNode] = self
+        while node is not None:
+            nodes.append(node)
+            node = node.upstream
+        return list(reversed(nodes))
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Per-execute input placeholder (``with InputNode() as inp:`` in the
+    reference; plain construction here)."""
+
+    def __init__(self):
+        super().__init__(None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_handle, method_name: str, upstream: DAGNode):
+        super().__init__(upstream)
+        self.actor = actor_handle
+        self.method_name = method_name
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name})"
