@@ -176,10 +176,17 @@ class Cluster:
         self.shutdown()
 
 
-def connect(gcs_address: str, namespace: str = "default") -> CoreWorker:
-    """Attach this process as a driver (``ray.init(address=...)`` analog)."""
+def connect(gcs_address: str, namespace: str = "default",
+            log_to_driver: bool = False) -> CoreWorker:
+    """Attach this process as a driver (``ray.init(address=...)`` analog).
+
+    ``log_to_driver=True`` mirrors every worker's stdout/stderr to this
+    process (daemon log tailers → GCS pubsub → long-poll subscriber).
+    """
     from ray_tpu.core import runtime as runtime_mod
 
     core = CoreWorker(gcs_address, namespace=namespace, mode="driver")
     runtime_mod._global_runtime = core
+    if log_to_driver:
+        core.start_log_mirroring()
     return core

@@ -76,6 +76,12 @@ class Config:
     # and pool sizing in worker_pool.cc).
     max_workers_per_node = _Flag(8)
 
+    # -- memory monitor / OOM policy (memory_monitor.h:52 analog) -------------
+    # Node memory-usage fraction above which the daemon kills the newest
+    # busy TASK worker (retriable-FIFO policy). >=1.0 disables.
+    memory_monitor_threshold = _Flag(0.95)
+    memory_monitor_period_s = _Flag(1.0)
+
     # -- health / fault tolerance --------------------------------------------
     # Health-check period and failure threshold (reference
     # ray_config_def.h:841-847 health_check_{initial_delay,period,timeout}_ms,

@@ -840,6 +840,40 @@ class CoreWorker:
     def get_placement_group(self, pg_id) -> Optional[dict]:
         return self._gcs_rpc.call("get_placement_group", pg_id)
 
+    # ====================== log mirroring ======================
+
+    def start_log_mirroring(self, sink=None) -> None:
+        """Mirror worker stdout/stderr to this driver (the reference's
+        GcsLogSubscriber path: node daemons tail worker log files into the
+        GCS "logs" pubsub channel; we long-poll it)."""
+        if getattr(self, "_log_thread", None) is not None:
+            return
+        sink = sink or (lambda entry, line: print(
+            f"({entry['worker']}, node {entry['node_id'][:8]}) {line}"))
+
+        def poll_loop():
+            cursor = 0
+            client = RpcClient(self.gcs_address)
+            while not self._shutdown:
+                try:
+                    cursor, messages = client.call(
+                        "poll_channel", "logs", cursor, 10.0, timeout=30.0)
+                except (RpcConnectionError, TimeoutError):
+                    time.sleep(1.0)
+                    continue
+                for batch in messages:
+                    for entry in batch:
+                        for line in entry["lines"]:
+                            try:
+                                sink(entry, line)
+                            except Exception:  # noqa: BLE001
+                                pass
+            client.close()
+
+        self._log_thread = threading.Thread(
+            target=poll_loop, name="log-mirror", daemon=True)
+        self._log_thread.start()
+
     # ====================== lifecycle ======================
 
     def shutdown(self) -> None:

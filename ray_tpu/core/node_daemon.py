@@ -45,6 +45,24 @@ logger = get_logger("node_daemon")
 from ray_tpu.core.exceptions import WorkerDiedError
 
 
+def _memory_usage_fraction() -> Optional[float]:
+    """Node memory pressure from /proc/meminfo (1 - available/total)."""
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if parts[0] in ("MemTotal:", "MemAvailable:"):
+                    info[parts[0]] = int(parts[1])
+        total = info.get("MemTotal:")
+        avail = info.get("MemAvailable:")
+        if not total or avail is None:
+            return None
+        return 1.0 - avail / total
+    except OSError:
+        return None
+
+
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy",
                  "env_key")
@@ -95,6 +113,12 @@ class NodeDaemon:
         self._idle: List[_Worker] = []
         self._spawn_pending = 0  # spawned but not yet registered
         self._demand = 0  # _pop_worker calls currently waiting
+        # Session log dir: per-worker stdout/stderr files, tailed into the
+        # GCS "logs" pubsub channel (log_monitor.py analog).
+        self._log_dir = os.path.join(
+            "/tmp/ray_tpu_session_logs", self.node_id.hex()[:12])
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._log_offsets: Dict[str, int] = {}
         num_cpus = resources.get("CPU", os.cpu_count() or 4)
         self._max_workers = max(int(num_cpus) * 2, cfg.max_workers_per_node)
 
@@ -124,6 +148,10 @@ class NodeDaemon:
                          daemon=True).start()
         threading.Thread(target=self._reaper_loop, name="daemon-reaper",
                          daemon=True).start()
+        threading.Thread(target=self._log_tail_loop, name="daemon-logtail",
+                         daemon=True).start()
+        threading.Thread(target=self._memory_monitor_loop,
+                         name="daemon-memmon", daemon=True).start()
 
     # ====================== heartbeat / lifecycle ======================
 
@@ -173,6 +201,9 @@ class NodeDaemon:
                 self._shm.destroy()
             except Exception:  # noqa: BLE001
                 pass
+        import shutil
+
+        shutil.rmtree(self._log_dir, ignore_errors=True)
         self._server.stop()
 
     # ====================== worker pool ======================
@@ -188,10 +219,16 @@ class NodeDaemon:
         env["RAY_TPU_STORE_NAME"] = self.store_name
         if extra_env:
             env.update({k: str(v) for k, v in extra_env.items()})
+        # Worker stdout/stderr land in per-worker session logs (reference:
+        # every process writes session/logs/*; the log monitor tails them).
+        log_path = os.path.join(self._log_dir,
+                                f"worker-{worker_id.hex()[:12]}.log")
+        log_file = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
+            env=env, stdout=log_file, stderr=subprocess.STDOUT,
         )
+        log_file.close()  # the child holds its own fd
         worker = _Worker(worker_id, proc, env_key=env_key)
         self._workers[worker_id] = worker
         return worker
@@ -482,6 +519,104 @@ class NodeDaemon:
         # ObjectID is 28 bytes; the native arena keys are 20. Use the task-id
         # tail + return index — unique because the task-id tail is random.
         return object_id[-20:]
+
+    # ====================== logs (log_monitor.py analog) ======================
+
+    def _log_tail_loop(self) -> None:
+        """Tail worker log files; publish new lines to the GCS "logs"
+        channel so drivers can mirror them (GcsLogSubscriber analog)."""
+        while not self._stopped.wait(0.5):
+            try:
+                batch = self._collect_new_log_lines()
+            except OSError:
+                continue
+            if batch:
+                try:
+                    self._gcs.notify("publish", "logs", batch)
+                except RpcConnectionError:
+                    pass
+
+    _LOG_WINDOW = 256 * 1024
+
+    def _collect_new_log_lines(self) -> List[dict]:
+        batch: List[dict] = []
+        for fname in os.listdir(self._log_dir):
+            path = os.path.join(self._log_dir, fname)
+            offset = self._log_offsets.get(fname, 0)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size <= offset:
+                continue
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(self._LOG_WINDOW)
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                if len(chunk) < self._LOG_WINDOW:
+                    continue  # partial line still being written — wait
+                # A single line larger than the window: force-advance past
+                # the whole chunk (never livelock on it) and mark the cut.
+                self._log_offsets[fname] = offset + len(chunk)
+                lines = [chunk.decode("utf-8", "replace")
+                         + " …[line truncated by log tailer]"]
+            else:
+                # Offset advances exactly over the lines we publish — lines
+                # are never skipped, the window just paces throughput.
+                self._log_offsets[fname] = offset + last_nl + 1
+                lines = chunk[:last_nl].decode("utf-8", "replace").splitlines()
+            batch.append({
+                "node_id": self.node_id.hex(),
+                "worker": fname.rsplit(".", 1)[0],
+                "lines": lines,
+            })
+        return batch
+
+    def tail_worker_logs(self, max_bytes: int = 64 * 1024) -> Dict[str, str]:
+        """Last chunk of every worker's log (state API / debugging)."""
+        out = {}
+        for fname in os.listdir(self._log_dir):
+            path = os.path.join(self._log_dir, fname)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    f.seek(max(0, size - max_bytes))
+                    out[fname] = f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+        return out
+
+    # ====================== memory monitor / OOM policy ======================
+
+    def _memory_monitor_loop(self) -> None:
+        """Node OOM protection (memory_monitor.h:52 + the retriable-FIFO
+        worker killing policy): when the node crosses the usage threshold,
+        kill the NEWEST busy task worker — its task retries elsewhere via
+        the normal WorkerDiedError path — never parked actors first."""
+        threshold = config().memory_monitor_threshold
+        if threshold >= 1.0:
+            return  # disabled
+        while not self._stopped.wait(config().memory_monitor_period_s):
+            usage = _memory_usage_fraction()
+            if usage is None or usage < threshold:
+                continue
+            victim = None
+            with self._pool_lock:
+                busy_tasks = [w for w in self._workers.values()
+                              if w.busy and w.actor_id is None
+                              and w.proc.poll() is None]
+                if busy_tasks:
+                    victim = max(busy_tasks, key=lambda w: w.proc.pid)
+            if victim is not None:
+                logger.warning(
+                    "node memory %.0f%% >= %.0f%% — killing newest task "
+                    "worker pid %d (task will retry)",
+                    usage * 100, threshold * 100, victim.proc.pid)
+                try:
+                    victim.proc.kill()
+                except OSError:
+                    pass
 
     def stats(self) -> dict:
         with self._pool_lock:

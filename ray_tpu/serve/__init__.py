@@ -15,6 +15,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment",
@@ -30,4 +31,6 @@ __all__ = [
     "AutoscalingConfig",
     "DeploymentConfig",
     "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
 ]

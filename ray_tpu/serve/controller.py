@@ -45,6 +45,9 @@ class ServeControllerActor:
         self._running = True
         self._metrics: Dict[str, float] = {}  # deployment -> reported ongoing
         self._last_downscale: Dict[str, float] = {}
+        # deployment -> {replica key -> loaded multiplexed model ids}
+        self._model_ids: Dict[str, Dict[str, list]] = {}
+        self._model_poll_tick = 0
         self._reconcile_thread = threading.Thread(target=self._loop, daemon=True)
         self._reconcile_thread.start()
 
@@ -113,6 +116,8 @@ class ServeControllerActor:
                     ],
                     "max_ongoing_requests": t.config.max_ongoing_requests,
                     "route_prefix": t.route_prefix,
+                    # model-aware routing (pow_2_scheduler.py:127-135)
+                    "model_ids": dict(self._model_ids.get(name, {})),
                 }
                 for name, t in self._targets.items()
             }
@@ -129,9 +134,48 @@ class ServeControllerActor:
             try:
                 self._autoscale()
                 self._reconcile_once()
+                self._model_poll_tick += 1
+                if self._model_poll_tick % 10 == 0:
+                    self._poll_multiplexed_ids()
             except Exception:
                 pass
             time.sleep(0.05)
+
+    def _poll_multiplexed_ids(self):
+        """Collect each replica's loaded model set (the reference pushes
+        from replicas via record_multiplexed_model_ids; polling keeps the
+        replica surface passive). A replica that doesn't answer in time —
+        e.g. serially busy with a long inference — KEEPS its last-known
+        entry: stale warm-routing info beats flapping the routers' tables
+        exactly when the replica is loaded. Version bump on change
+        re-triggers the routers' long-poll."""
+        with self._lock:
+            replicas = {n: list(rs) for n, rs in self._replicas.items()}
+        changed = False
+        for name, pairs in replicas.items():
+            with self._lock:
+                table = dict(self._model_ids.get(name, {}))
+            live_keys = set()
+            for _v, replica in pairs:
+                key = replica.actor_id.hex()
+                live_keys.add(key)
+                try:
+                    ids = ray_tpu.get(
+                        replica.multiplexed_model_ids.remote(), timeout=0.5)
+                except Exception:  # noqa: BLE001 — busy or mid-restart:
+                    continue       # keep the previous entry
+                if ids:
+                    table[key] = ids
+                else:
+                    table.pop(key, None)
+            table = {k: v for k, v in table.items() if k in live_keys}
+            with self._lock:
+                if self._model_ids.get(name) != table:
+                    self._model_ids[name] = table
+                    changed = True
+        if changed:
+            with self._lock:
+                self._version += 1
 
     def _autoscale(self):
         with self._lock:

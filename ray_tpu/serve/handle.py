@@ -73,6 +73,7 @@ class Router:
         self._version = -1
         self._replicas: List[Any] = []
         self._max_ongoing = 100
+        self._model_ids: Dict[str, list] = {}  # replica key -> loaded models
         self._ongoing: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
@@ -94,6 +95,7 @@ class Router:
                     self._version = version
                     self._replicas = entry["replicas"]
                     self._max_ongoing = entry["max_ongoing_requests"]
+                    self._model_ids = entry.get("model_ids", {})
                 self._last_refresh = now
                 return
             if not block or time.monotonic() > deadline:
@@ -109,19 +111,33 @@ class Router:
             if key in self._ongoing:
                 self._ongoing[key] = max(0, self._ongoing[key] - 1)
 
-    def _pick(self):
+    def _pick(self, model_id: str = ""):
         """Pow-2: sample two replicas, choose the lower client-side queue.
-        Block (with periodic refresh) while all replicas are saturated."""
+        With a ``model_id``, replicas that already hold the model are
+        preferred (pow_2_scheduler.py:127-135) — cold replicas only load it
+        when every warm one is saturated. Blocks (with periodic refresh)
+        while all candidates are saturated."""
         deadline = time.monotonic() + 60.0
         while True:
             self._refresh()
             with self._lock:
                 replicas = list(self._replicas)
+                warm_keys = {
+                    k for k, ids in self._model_ids.items() if model_id in ids
+                } if model_id else set()
             if replicas:
-                if len(replicas) == 1:
-                    cands = [replicas[0]]
+                pool = replicas
+                if model_id:
+                    warm = [r for r in replicas if self._key(r) in warm_keys]
+                    # Saturated warm replicas fall through to the full pool.
+                    warm_free = [r for r in warm if self._ongoing.get(
+                        self._key(r), 0) < self._max_ongoing]
+                    if warm_free:
+                        pool = warm_free
+                if len(pool) == 1:
+                    cands = [pool[0]]
                 else:
-                    cands = random.sample(replicas, 2)
+                    cands = random.sample(pool, 2)
                 cands.sort(key=lambda r: self._ongoing.get(self._key(r), 0))
                 best = cands[0]
                 key = self._key(best)
@@ -151,18 +167,26 @@ class DeploymentHandle:
         self._metrics_thread = threading.Thread(target=self._push_metrics, daemon=True)
         self._metrics_thread.start()
 
-    def options(self, *, method_name: Optional[str] = None, stream: bool = False) -> "DeploymentHandle":
+    def options(self, *, method_name: Optional[str] = None, stream: bool = False,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h._name = self._name
         h._controller = self._controller
         h._method = method_name or self._method
         h._router = self._router
         h._stream = stream
+        # None = inherit; explicit "" clears a pinned model id.
+        h._model_id = (multiplexed_model_id
+                       if multiplexed_model_id is not None
+                       else getattr(self, "_model_id", ""))
         h._metrics_thread = self._metrics_thread
         return h
 
     def remote(self, *args, **kwargs):
-        replica, key = self._router._pick()
+        model_id = getattr(self, "_model_id", "")
+        replica, key = self._router._pick(model_id)
+        if model_id:
+            kwargs["_multiplexed_model_id"] = model_id
         if self._stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming"

@@ -1,0 +1,137 @@
+"""Model multiplexing — many models per deployment, routed to warm replicas.
+
+Analog of the reference's ``python/ray/serve/_private/multiplex.py``
+(``_ModelMultiplexWrapper``) and the pow-2 scheduler's model-aware routing
+(``replica_scheduler/pow_2_scheduler.py:127-135``): a replica method
+decorated with ``@serve.multiplexed(max_num_models_per_replica=N)`` loads
+models on demand into a per-replica LRU; each loaded set is reported to the
+controller, and the router prefers replicas that already hold the requested
+``multiplexed_model_id`` — cold replicas only see a model id when every warm
+one is saturated, so the cluster converges to a stable model↔replica
+assignment without any central planner.
+
+TPU note: "model" here is typically a params pytree already resident in
+device HBM — the LRU bound is the HBM budget, and routing-to-warm avoids
+re-uploading weights through the host for every request.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ray_tpu_multiplexed_model_id", default="")
+
+# Per-process registry of wrappers so the hosting replica can report its
+# loaded model ids (one replica process hosts at most one deployment).
+_wrappers: List["_ModelMultiplexWrapper"] = []
+_wrappers_lock = threading.Lock()
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the CURRENT request (reference:
+    ``serve.get_multiplexed_model_id``)."""
+    return _current_model_id.get()
+
+
+class _ModelMultiplexWrapper:
+    """LRU of loaded models keyed by model id."""
+
+    def __init__(self, loader: Callable[[Any, str], Any],
+                 max_num_models: int):
+        self._loader = loader
+        self._max = max_num_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        # Per-model load gates: concurrent cold requests for the SAME model
+        # must not each run the loader (two HBM weight uploads, transient 2x
+        # memory). One thread loads; the rest wait on its gate.
+        self._loading: dict = {}
+        with _wrappers_lock:
+            _wrappers.append(self)
+
+    def loaded_model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._models.keys())
+
+    def load(self, instance, model_id: str) -> Any:
+        while True:
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                gate = self._loading.get(model_id)
+                if gate is None:
+                    gate = threading.Event()
+                    self._loading[model_id] = gate
+                    break  # this thread loads
+            gate.wait(timeout=600)
+            # loader finished (or failed) — re-check the cache
+        try:
+            model = self._loader(instance, model_id)
+            with self._lock:
+                self._models[model_id] = model
+                self._models.move_to_end(model_id)
+                if len(self._models) > self._max:
+                    self._models.popitem(last=False)  # LRU eviction
+            return model
+        finally:
+            with self._lock:
+                self._loading.pop(model_id, None)
+            gate.set()
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for a replica's model-loader method::
+
+        @serve.deployment
+        class Models:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id: str):
+                return load_params(model_id)   # cached per replica, LRU
+
+            def __call__(self, payload):
+                model = self.get_model(serve.get_multiplexed_model_id())
+                return infer(model, payload)
+
+    Callers pick the model with
+    ``handle.options(multiplexed_model_id="m1").remote(...)``.
+    """
+
+    def decorate(loader: Callable) -> Callable:
+        wrapper = _ModelMultiplexWrapper(loader, max_num_models_per_replica)
+
+        def bound(self, model_id: Optional[str] = None):
+            mid = model_id if model_id is not None else get_multiplexed_model_id()
+            if not mid:
+                raise ValueError(
+                    "no model id: pass one explicitly or set "
+                    "handle.options(multiplexed_model_id=...) on the caller")
+            return wrapper.load(self, mid)
+
+        bound.__name__ = getattr(loader, "__name__", "get_model")
+        bound._multiplex_wrapper = wrapper
+        return bound
+
+    return decorate
+
+
+def loaded_model_ids() -> List[str]:
+    """All model ids loaded in this process (union over wrappers)."""
+    with _wrappers_lock:
+        wrappers = list(_wrappers)
+    out: List[str] = []
+    for w in wrappers:
+        out.extend(w.loaded_model_ids())
+    return out
+
+
+def set_current_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+def reset_current_model_id(token) -> None:
+    _current_model_id.reset(token)

@@ -51,8 +51,18 @@ class ReplicaActor:
         with self._lock:
             return {"ongoing": float(self._ongoing), "total": float(self._total)}
 
+    def multiplexed_model_ids(self) -> list:
+        """Model ids loaded in this replica (multiplex.py registry)."""
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return loaded_model_ids()
+
     # -- data plane ----------------------------------------------------------
     def handle_request(self, method_name: str, *args, **kwargs):
+        from ray_tpu.serve import multiplex
+
+        model_id = kwargs.pop("_multiplexed_model_id", "")
+        token = multiplex.set_current_model_id(model_id)
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -69,11 +79,16 @@ class ReplicaActor:
                 return list(result)
             return result
         finally:
+            multiplex.reset_current_model_id(token)
             with self._lock:
                 self._ongoing -= 1
 
     def handle_request_streaming(self, method_name: str, *args, **kwargs):
         """Generator method: yields items (streamed via ObjectRefGenerator)."""
+        from ray_tpu.serve import multiplex
+
+        model_id = kwargs.pop("_multiplexed_model_id", "")
+        token = multiplex.set_current_model_id(model_id)
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -97,6 +112,7 @@ class ReplicaActor:
             else:
                 yield result
         finally:
+            multiplex.reset_current_model_id(token)
             with self._lock:
                 self._ongoing -= 1
 

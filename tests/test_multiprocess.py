@@ -544,3 +544,71 @@ def test_runtime_env_env_vars(driver):
     val2, plain_pid = ray_tpu.get(read_plain.remote(), timeout=120)
     assert val2 is None  # vanilla pool never contaminated
     assert env_pid != plain_pid
+
+
+def test_worker_log_aggregation():
+    """Worker prints land in per-worker session logs, stream through the
+    GCS "logs" channel, and mirror to the driver (log_monitor.py analog)."""
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            captured = []
+            core.start_log_mirroring(
+                sink=lambda entry, line: captured.append((entry["worker"], line)))
+
+            @ray_tpu.remote
+            def chatty():
+                print("hello-from-worker-log")
+                return 1
+
+            assert ray_tpu.get(chatty.remote(), timeout=120) == 1
+            assert _wait_for(
+                lambda: any("hello-from-worker-log" in line
+                            for _, line in captured),
+                timeout=30,
+            ), captured
+            # Raw tail RPC (state API path) sees it too.
+            tails = core._daemons.get(cluster.nodes[0].address).call(
+                "tail_worker_logs", timeout=10)
+            assert any("hello-from-worker-log" in text
+                       for text in tails.values())
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+
+
+def test_memory_monitor_kills_newest_task_worker():
+    """OOM policy: above the usage threshold the daemon kills the newest
+    busy TASK worker (retriable-FIFO analog); parked actors survive."""
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2},
+                      system_config={"memory_monitor_threshold": 0.0001,
+                                     "memory_monitor_period_s": 0.2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            class Bystander:
+                def ping(self):
+                    return "alive"
+
+            b = Bystander.remote()
+            assert ray_tpu.get(b.ping.remote(), timeout=120) == "alive"
+
+            @ray_tpu.remote(max_retries=0)
+            def hog():
+                time.sleep(10.0)
+                return "survived"
+
+            ref = hog.remote()
+            with pytest.raises(Exception, match="worker died|WorkerDied"):
+                ray_tpu.get(ref, timeout=120)
+            # The actor was never a kill candidate.
+            assert ray_tpu.get(b.ping.remote(), timeout=60) == "alive"
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()

@@ -225,3 +225,52 @@ class TestHttpProxy:
         assert r.json() == {"doubled": 42}
         r = httpx.get("http://127.0.0.1:18431/nope", timeout=10)
         assert r.status_code == 404
+
+
+class TestMultiplexing:
+    """Model multiplexing (serve/_private/multiplex.py analog)."""
+
+    def test_lru_loading_and_context(self, serve_instance):
+        from ray_tpu import serve
+
+        loads = []
+
+        @serve.deployment(num_replicas=1)
+        class Models:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                loads.append(model_id)
+                return {"id": model_id}
+
+            def __call__(self, payload):
+                mid = serve.get_multiplexed_model_id()
+                model = self.get_model(mid)
+                return {"served_by": model["id"], "ctx": mid}
+
+        handle = serve.run(Models.bind())
+        r1 = handle.options(multiplexed_model_id="m1").remote({}).result(timeout_s=60)
+        assert r1 == {"served_by": "m1", "ctx": "m1"}
+        r2 = handle.options(multiplexed_model_id="m2").remote({}).result(timeout_s=60)
+        assert r2["served_by"] == "m2"
+        # Cached: repeat m1 loads nothing new.
+        handle.options(multiplexed_model_id="m1").remote({}).result(timeout_s=60)
+        # Third model evicts the LRU entry (m2 after the m1 re-touch).
+        handle.options(multiplexed_model_id="m3").remote({}).result(timeout_s=60)
+        handle.options(multiplexed_model_id="m2").remote({}).result(timeout_s=60)
+        assert loads == ["m1", "m2", "m3", "m2"]
+
+    def test_missing_model_id_raises(self, serve_instance):
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class M:
+            @serve.multiplexed()
+            def get_model(self, model_id: str):
+                return model_id
+
+            def __call__(self, payload):
+                return self.get_model()
+
+        handle = serve.run(M.bind())
+        with pytest.raises(Exception, match="no model id"):
+            handle.remote({}).result(timeout_s=60)
