@@ -3,12 +3,13 @@
 Analog of the reference's ``python/ray/data/_internal/execution/``
 (``StreamingExecutor`` ``streaming_executor.py:51``, operators under
 ``operators/``, backpressure policies): the optimized plan compiles to a
-chain of generators over block refs. Each map stage keeps at most
+chain of generators over block refs. Task map stages keep at most
 ``max_in_flight`` tasks outstanding (backpressure: a stage only submits when
 the consumer pulls), so a Dataset never materializes fully unless an
-all-to-all barrier requires it. Map stages run as runtime TASKS (or a
-round-robin ACTOR pool for ``compute="actors"`` — the analog of
-``ActorPoolMapOperator``).
+all-to-all barrier requires it. ``compute="actors"`` runs an AUTOSCALING
+actor pool (least-loaded dispatch, ``concurrency=(min, max)``, backlog-driven
+scale-up, drain-time retirement — the ``ActorPoolMapOperator`` analog) whose
+outstanding cap grows with the pool: ``max(2·actors, max_in_flight)``.
 """
 
 from __future__ import annotations
@@ -142,31 +143,77 @@ def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
 
 
 def _actor_map(op: MapBlocks, upstream: Iterator[Any], max_in_flight: int) -> Iterator[Any]:
-    pool_size = op.concurrency or 2
+    """Autoscaling actor pool (reference: ``ActorPoolMapOperator`` with the
+    autoscaling policy of ``_internal/execution/autoscaler``): ``compute=
+    "actors"`` with ``concurrency=(min, max)`` starts ``min`` actors, adds
+    one whenever every actor already has ≥2 blocks in flight (backlog), and
+    retires the emptiest actors once the input is exhausted and the backlog
+    drains below the pool size. A plain int pins the pool size."""
+    conc = op.concurrency
+    if isinstance(conc, (tuple, list)):
+        min_actors, max_actors = int(conc[0]), int(conc[1])
+    else:
+        min_actors = max_actors = int(conc or 2)
     actor_cls = ray_tpu.remote(_MapActorImpl)
-    actors = [actor_cls.options(num_cpus=op.num_cpus).remote() for _ in range(pool_size)]
+
+    def spawn():
+        return actor_cls.options(num_cpus=op.num_cpus).remote()
+
+    actors: list = [spawn() for _ in range(max(1, min_actors))]
+    # submitted-not-yet-yielded per actor (the executor's load signal)
+    load: dict = {id(a): 0 for a in actors}
+    # EVERY ref an actor was given: killing an actor is only safe after its
+    # tasks finished (kill drains the mailbox into ActorDiedError, which
+    # would poison refs already yielded to the consumer).
+    submitted: dict = {id(a): [] for a in actors}
+
+    def _safe_kill(actor) -> None:
+        refs = submitted.get(id(actor), [])
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=60.0)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            ray_tpu.kill(actor)
+        except Exception:  # noqa: BLE001
+            pass
 
     def gen() -> Iterator[Any]:
-        pending: deque = deque()
+        pending: deque = deque()  # (ref, actor)
         exhausted = False
-        i = 0
         try:
             while True:
-                while not exhausted and len(pending) < pool_size * 2:
+                while not exhausted and len(pending) < max(
+                        2 * len(actors), max_in_flight):
                     ref = next(upstream, None)
                     if ref is None:
                         exhausted = True
                         break
-                    pending.append(actors[i % pool_size].apply.remote(op.fn, ref))
-                    i += 1
+                    target = min(actors, key=lambda a: load[id(a)])
+                    if load[id(target)] >= 2 and len(actors) < max_actors:
+                        target = spawn()
+                        actors.append(target)
+                        load[id(target)] = 0
+                        submitted[id(target)] = []
+                    out_ref = target.apply.remote(op.fn, ref)
+                    load[id(target)] += 1
+                    submitted[id(target)].append(out_ref)
+                    pending.append((out_ref, target))
                 if not pending:
                     return
-                yield pending.popleft()
+                out, actor = pending.popleft()
+                load[id(actor)] -= 1
+                # Retire surplus idle actors while the tail drains.
+                if exhausted and len(actors) > min_actors:
+                    idle = [a for a in actors if load[id(a)] == 0]
+                    for a in idle[:len(actors) - max(1, min_actors)]:
+                        actors.remove(a)
+                        load.pop(id(a), None)
+                        _safe_kill(a)
+                yield out
         finally:
             for a in actors:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
+                _safe_kill(a)
 
     return gen()

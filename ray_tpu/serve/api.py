@@ -18,6 +18,7 @@ from ray_tpu.serve.deployment import Application, Deployment
 from ray_tpu.serve.handle import DeploymentHandle
 
 _proxy = None  # module-level HTTP proxy singleton
+_grpc_proxy = None  # module-level gRPC proxy singleton
 
 
 def run(
@@ -28,6 +29,8 @@ def run(
     blocking: bool = False,
     _start_proxy: bool = False,
     http_port: int = 8000,
+    _start_grpc_proxy: bool = False,
+    grpc_port: int = 0,
 ) -> DeploymentHandle:
     if not ray_tpu.is_initialized():
         ray_tpu.init()
@@ -64,7 +67,19 @@ def run(
 
             _proxy = HttpProxy(controller, port=http_port)
             _proxy.start()
+    if _start_grpc_proxy:
+        global _grpc_proxy
+        if _grpc_proxy is None:
+            from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+            _grpc_proxy = GrpcProxy(controller, port=grpc_port)
+            _grpc_proxy.start()
     return ingress
+
+
+def grpc_proxy_address() -> Optional[str]:
+    """Address of the running gRPC ingress (None if not started)."""
+    return _grpc_proxy.address if _grpc_proxy is not None else None
 
 
 def _wait_ready(controller, names, timeout_s: float = 30.0) -> None:
@@ -95,10 +110,13 @@ def delete(deployment_name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:

@@ -1,0 +1,199 @@
+"""gRPC proxy — the second ingress data plane.
+
+Analog of the reference's gRPC proxy (``serve/_private/proxy.py`` gRPC half;
+service schema ``src/ray/protobuf/serve.proto``). The reference compiles
+user protos; here the ingress speaks ONE generic service so no protoc step
+is needed:
+
+    service RayTpuServe {
+      rpc Call       (Request) returns (Reply);        // unary
+      rpc CallStream (Request) returns (stream Reply); // server streaming
+    }
+    message Request { bytes payload = 1; }  // JSON (or pickled) body
+    message Reply   { bytes payload = 1; }
+
+Routing is by gRPC metadata: ``application`` selects the deployment (same
+names as HTTP route prefixes), optional ``method`` the callable's method,
+optional ``multiplexed_model_id`` pins a model. Payloads are JSON by
+default; ``payload-type: pickle`` metadata switches to pickle for arbitrary
+Python values ("content-type" is reserved by the gRPC transport itself).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+_PICKLE = "pickle"
+
+
+def _encode_payload_field(data: bytes) -> bytes:
+    """Wire-encode ``message { bytes payload = 1; }`` without protoc:
+    field 1, wire type 2 (length-delimited) = tag byte 0x0A + varint len."""
+    out = bytearray([0x0A])
+    n = len(data)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            break
+    out.extend(data)
+    return bytes(out)
+
+
+def _decode_payload_field(message: bytes) -> bytes:
+    if not message:
+        return b""
+    if message[0] != 0x0A:
+        raise ValueError("expected field 1 (payload) length-delimited")
+    n, shift, i = 0, 0, 1
+    while True:
+        if i >= len(message):
+            raise ValueError("truncated varint in payload field")
+        b = message[i]
+        n |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    if i + n > len(message):
+        raise ValueError(
+            f"payload declares {n} bytes but only {len(message) - i} present")
+    return message[i:i + n]
+
+
+class _GenericServeHandler:
+    """grpc.GenericRpcHandler dispatching the two generic methods."""
+
+    SERVICE = "ray_tpu.serve.RayTpuServe"
+
+    def __init__(self, proxy: "GrpcProxy"):
+        self._proxy = proxy
+
+    def service(self, handler_call_details):
+        import grpc
+
+        method = handler_call_details.method
+        if method == f"/{self.SERVICE}/Call":
+            return grpc.unary_unary_rpc_method_handler(
+                self._proxy._handle_unary,
+                request_deserializer=_decode_payload_field,
+                response_serializer=_encode_payload_field,
+            )
+        if method == f"/{self.SERVICE}/CallStream":
+            return grpc.unary_stream_rpc_method_handler(
+                self._proxy._handle_stream,
+                request_deserializer=_decode_payload_field,
+                response_serializer=_encode_payload_field,
+            )
+        return None
+
+
+class GrpcProxy:
+    """Ingress server; routes by metadata to deployment handles.
+
+    ``allow_pickle`` gates the ``application/x-pickle`` content type:
+    unpickling network bytes executes arbitrary code, so it is OFF by
+    default and should only be enabled on trusted (loopback/mesh-internal)
+    ingresses.
+    """
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0,
+                 allow_pickle: bool = False):
+        import grpc
+
+        self._controller = controller
+        self._allow_pickle = allow_pickle
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            handlers=(_GenericServeHandler(self),),
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.address = f"{host}:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+    # -- request path ---------------------------------------------------------
+
+    def _resolve(self, context) -> tuple:
+        import grpc
+
+        import ray_tpu
+
+        meta = {k: v for k, v in (context.invocation_metadata() or [])}
+        app = meta.get("application")
+        if not app:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "missing 'application' metadata")
+        with self._lock:
+            handle = self._handles.get(app)
+        if handle is None:
+            # Existence check first (cheap) so unknown apps fail with
+            # NOT_FOUND immediately instead of a blocking Router bootstrap;
+            # handle construction happens OUTSIDE the lock (it long-polls
+            # the controller) so one cold app can't stall other requests.
+            deployments = ray_tpu.get(
+                self._controller.list_deployments.remote(), timeout=10.0)
+            if app not in deployments:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no deployment named '{app}'")
+            handle = DeploymentHandle(app, controller=self._controller)
+            with self._lock:
+                handle = self._handles.setdefault(app, handle)
+        if meta.get("method"):
+            handle = handle.options(method_name=meta["method"])
+        if meta.get("multiplexed_model_id"):
+            handle = handle.options(
+                multiplexed_model_id=meta["multiplexed_model_id"])
+        pickled = meta.get("payload-type") == _PICKLE
+        if pickled and not self._allow_pickle:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "pickle payloads are disabled on this ingress "
+                "(GrpcProxy(allow_pickle=True) opts in; unpickling network "
+                "bytes executes arbitrary code)")
+        return handle, pickled
+
+    @staticmethod
+    def _loads(payload: bytes, pickled: bool) -> Any:
+        if pickled:
+            from ray_tpu.core import serialization
+
+            return serialization.loads(payload)
+        return json.loads(payload.decode()) if payload else None
+
+    @staticmethod
+    def _dumps(value: Any, pickled: bool) -> bytes:
+        if pickled:
+            from ray_tpu.core import serialization
+
+            return serialization.dumps(value)
+        return json.dumps(value).encode()
+
+    def _handle_unary(self, payload: bytes, context) -> Any:
+        handle, pickled = self._resolve(context)
+        value = self._loads(payload, pickled)
+        # Honor the client's RPC deadline so stuck deployments can't pin
+        # the ingress thread pool for the full default.
+        remaining = context.time_remaining()
+        timeout = min(60.0, remaining) if remaining is not None else 60.0
+        result = handle.remote(value).result(timeout_s=timeout)
+        return self._dumps(result, pickled)
+
+    def _handle_stream(self, payload: bytes, context):
+        handle, pickled = self._resolve(context)
+        value = self._loads(payload, pickled)
+        for item in handle.options(stream=True).remote(value):
+            yield self._dumps(item, pickled)

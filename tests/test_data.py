@@ -299,3 +299,24 @@ class TestPushBasedShuffle:
 
 
 from builtins import range as builtins_range  # noqa: E402
+
+
+class TestAutoscalingActorPool:
+    def test_tuple_concurrency_scales_and_completes(self, ray_start_regular):
+        from ray_tpu import data
+
+        ds = data.range(400, override_num_blocks=8).map_batches(
+            lambda b: {"id": b["id"] * 2},
+            compute="actors", concurrency=(1, 3), batch_format="numpy",
+        )
+        out = sorted(r["id"] for r in ds.take_all())
+        assert out == [i * 2 for i in builtins_range(400)]
+
+    def test_int_concurrency_fixed_pool(self, ray_start_regular):
+        from ray_tpu import data
+
+        ds = data.range(100, override_num_blocks=4).map_batches(
+            lambda b: {"id": b["id"] + 1},
+            compute="actors", concurrency=2, batch_format="numpy",
+        )
+        assert sorted(r["id"] for r in ds.take_all()) == list(builtins_range(1, 101))

@@ -274,3 +274,68 @@ class TestMultiplexing:
         handle = serve.run(M.bind())
         with pytest.raises(Exception, match="no model id"):
             handle.remote({}).result(timeout_s=60)
+
+
+class TestGrpcIngress:
+    """Generic gRPC ingress (reference: serve gRPC proxy + serve.proto)."""
+
+    def test_unary_and_streaming(self, serve_instance):
+        import grpc
+        from ray_tpu import serve
+        from ray_tpu.serve import api as serve_api
+        from ray_tpu.serve.grpc_proxy import (
+            _decode_payload_field,
+            _encode_payload_field,
+        )
+
+        @serve.deployment(num_replicas=1)
+        class Math:
+            def __call__(self, payload):
+                return {"doubled": payload["x"] * 2}
+
+            def countdown(self, payload):
+                for i in range(payload["n"], 0, -1):
+                    yield {"i": i}
+
+        serve.run(Math.bind(), _start_grpc_proxy=True)
+        addr = serve_api.grpc_proxy_address()
+        assert addr is not None
+
+        channel = grpc.insecure_channel(addr)
+        import json
+
+        unary = channel.unary_unary(
+            "/ray_tpu.serve.RayTpuServe/Call",
+            request_serializer=_encode_payload_field,
+            response_deserializer=_decode_payload_field,
+        )
+        reply = unary(json.dumps({"x": 21}).encode(),
+                      metadata=(("application", "Math"),), timeout=60)
+        assert json.loads(reply.decode()) == {"doubled": 42}
+
+        stream = channel.unary_stream(
+            "/ray_tpu.serve.RayTpuServe/CallStream",
+            request_serializer=_encode_payload_field,
+            response_deserializer=_decode_payload_field,
+        )
+        items = [json.loads(chunk.decode()) for chunk in stream(
+            json.dumps({"n": 3}).encode(),
+            metadata=(("application", "Math"), ("method", "countdown")),
+            timeout=60)]
+        assert items == [{"i": 3}, {"i": 2}, {"i": 1}]
+
+        # Missing application metadata -> INVALID_ARGUMENT.
+        with pytest.raises(grpc.RpcError) as err:
+            unary(b"{}", timeout=30)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # Unknown application -> prompt NOT_FOUND (no blocking bootstrap).
+        with pytest.raises(grpc.RpcError) as err:
+            unary(b"{}", metadata=(("application", "NoSuchApp"),), timeout=30)
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        # Pickle payloads rejected unless the ingress opted in.
+        with pytest.raises(grpc.RpcError) as err:
+            unary(b"{}", metadata=(("application", "Math"),
+                                   ("payload-type", "pickle")),
+                  timeout=30)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        channel.close()
