@@ -162,13 +162,26 @@ def _actor_map(op: MapBlocks, upstream: Iterator[Any], max_in_flight: int) -> It
     actors: list = [spawn() for _ in range(max(1, min_actors))]
     # submitted-not-yet-yielded per actor (the executor's load signal)
     load: dict = {id(a): 0 for a in actors}
-    # EVERY ref an actor was given: killing an actor is only safe after its
-    # tasks finished (kill drains the mailbox into ActorDiedError, which
-    # would poison refs already yielded to the consumer).
-    submitted: dict = {id(a): [] for a in actors}
+    # Refs YIELDED to the consumer whose tasks may still be running: killing
+    # an actor drains its mailbox into ActorDiedError, which would poison
+    # these (never-yielded pending refs are abandoned with the stream, so
+    # they need no drain). Pruned via zero-timeout waits so the list stays
+    # ~max_in_flight long and completed blocks aren't pinned forever.
+    yielded: dict = {id(a): [] for a in actors}
+
+    def _prune(actor) -> None:
+        refs = yielded.get(id(actor))
+        if refs:
+            try:
+                _, not_ready = ray_tpu.wait(refs, num_returns=len(refs),
+                                            timeout=0)
+                yielded[id(actor)] = not_ready
+            except Exception:  # noqa: BLE001
+                pass
 
     def _safe_kill(actor) -> None:
-        refs = submitted.get(id(actor), [])
+        _prune(actor)
+        refs = yielded.pop(id(actor), [])
         if refs:
             try:
                 ray_tpu.wait(refs, num_returns=len(refs), timeout=60.0)
@@ -195,15 +208,17 @@ def _actor_map(op: MapBlocks, upstream: Iterator[Any], max_in_flight: int) -> It
                         target = spawn()
                         actors.append(target)
                         load[id(target)] = 0
-                        submitted[id(target)] = []
+                        yielded[id(target)] = []
                     out_ref = target.apply.remote(op.fn, ref)
                     load[id(target)] += 1
-                    submitted[id(target)].append(out_ref)
                     pending.append((out_ref, target))
                 if not pending:
                     return
                 out, actor = pending.popleft()
                 load[id(actor)] -= 1
+                _prune(actor)
+                if id(actor) in yielded:
+                    yielded[id(actor)].append(out)
                 # Retire surplus idle actors while the tail drains.
                 if exhausted and len(actors) > min_actors:
                     idle = [a for a in actors if load[id(a)] == 0]

@@ -92,6 +92,113 @@ class _GroupState:
                 self.cv.notify_all()
             return result
 
+    # Descriptor-driven surface shared with the distributed backend.
+    def exchange_desc(self, rank: int, descriptor: tuple, value):
+        return self.exchange(rank, value,
+                             _compute_for(descriptor, self.world_size))
+
+    def p2p_send(self, src: int, dst: int, value) -> None:
+        with self.cv:
+            self.p2p.setdefault((src, dst), []).append(value)
+            self.cv.notify_all()
+
+    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
+        key = (src, dst)
+        with self.cv:
+            while not self.p2p.get(key):
+                if not self.cv.wait(timeout=timeout):
+                    raise TimeoutError(f"recv from rank {src} timed out")
+            return self.p2p[key].pop(0)
+
+
+def _compute_for(descriptor: tuple, world: int):
+    """Server-side compute for a descriptor-driven collective round.
+
+    Both backends funnel through this: the local backend calls it in
+    process, the "gloo" backend's rank-0 hub calls it after all ranks'
+    payloads arrive over RPC — one implementation of the math either way.
+    """
+    kind = descriptor[0]
+    if kind == "allreduce":
+        op = descriptor[1]
+        return lambda slots: _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
+    if kind == "barrier":
+        return lambda slots: None
+    if kind == "broadcast":
+        src = descriptor[1]
+        return lambda slots: slots[src]
+    if kind == "allgather":
+        return lambda slots: [slots[r] for r in sorted(slots)]
+    if kind == "reducescatter":
+        op = descriptor[1]
+
+        def compute(slots):
+            reduced = _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
+            return np.array_split(reduced, world, axis=0)
+
+        return compute
+    if kind == "alltoall":
+        def compute(slots):
+            split = {r: np.array_split(slots[r], world, axis=0) for r in slots}
+            return {r: np.concatenate(
+                [split[s][r] for s in sorted(split)], axis=0)
+                for r in range(world)}
+
+        return compute
+    raise ValueError(f"unknown collective descriptor {descriptor}")
+
+
+class _GroupHubService:
+    """Rank 0's RPC surface for the cross-process ("gloo") backend.
+
+    A hub topology: every rank ships its contribution to rank 0's hub,
+    which runs the same drain-guarded exchange as the local backend and
+    returns the round's result. The reference's gloo groups are likewise
+    host-side and rendezvous through a store; a ring/tree is a later
+    optimization — correctness and the API contract come first.
+    """
+
+    def __init__(self, world_size: int):
+        self.state = _GroupState(world_size)
+
+    def exchange(self, rank: int, descriptor: tuple, value):
+        compute = _compute_for(descriptor, self.state.world_size)
+        return self.state.exchange(rank, value, compute)
+
+    def p2p_send(self, src: int, dst: int, value) -> None:
+        self.state.p2p_send(src, dst, value)
+
+    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
+        return self.state.p2p_recv(src, dst, timeout)
+
+
+class _DistributedGroup:
+    """Client view of a gloo-backend group (duck-types _GroupState usage)."""
+
+    def __init__(self, world_size: int, hub_address: str, hub=None):
+        from ray_tpu.core.rpc import RpcClient
+
+        self.world_size = world_size
+        self._hub = hub  # rank 0 talks to its hub in-process
+        self._client = None if hub is not None else RpcClient(hub_address)
+
+    def exchange_desc(self, rank: int, descriptor: tuple, value):
+        if self._hub is not None:
+            return self._hub.exchange(rank, descriptor, value)
+        return self._client.call("exchange", rank, descriptor, value,
+                                 timeout=120.0)
+
+    def p2p_send(self, src: int, dst: int, value) -> None:
+        if self._hub is not None:
+            self._hub.p2p_send(src, dst, value)
+        else:
+            self._client.call("p2p_send", src, dst, value, timeout=60.0)
+
+    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
+        if self._hub is not None:
+            return self._hub.p2p_recv(src, dst, timeout)
+        return self._client.call("p2p_recv", src, dst, timeout, timeout=None)
+
 
 @dataclass
 class GroupInfo:
@@ -133,15 +240,18 @@ def init_collective_group(
     """
     if backend not in ("local", "gloo", "xla"):
         raise ValueError(f"unknown backend {backend}")
-    with _groups_lock:
-        state = _groups.get(group_name)
-        if state is None:
-            state = _GroupState(world_size)
-            _groups[group_name] = state
-        elif state.world_size != world_size:
-            raise ValueError(
-                f"group {group_name} exists with world_size={state.world_size}"
-            )
+    if backend == "gloo":
+        _init_distributed_group(world_size, rank, group_name)
+    else:
+        with _groups_lock:
+            state = _groups.get(group_name)
+            if state is None:
+                state = _GroupState(world_size)
+                _groups[group_name] = state
+            elif state.world_size != world_size:
+                raise ValueError(
+                    f"group {group_name} exists with world_size={state.world_size}"
+                )
     with _groups_lock:
         _ranks.setdefault(_ctx_key(), {})[group_name] = rank
     # Record membership in the control plane for observability.
@@ -153,9 +263,60 @@ def init_collective_group(
         pass
 
 
+def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None:
+    """Cross-process backend: rank 0 hosts the hub, its address rendezvouses
+    through the control plane's KV (exactly how the reference exchanges the
+    NCCL unique id — nccl_collective_group.py via the internal KV)."""
+    import time as _time
+
+    gcs = get_runtime().gcs
+    kv_key = f"collective:{group_name}:hub"
+    with _groups_lock:
+        existing = _groups.get(group_name)
+        if existing is not None and existing.world_size != world_size:
+            raise ValueError(
+                f"group {group_name} exists with world_size="
+                f"{existing.world_size}")
+    if rank == 0:
+        from ray_tpu.core.rpc import RpcServer
+
+        hub = _GroupHubService(world_size)
+        server = RpcServer(hub, name=f"collective-{group_name}",
+                           max_workers=max(8, world_size + 2))
+        gcs.kv_put(kv_key, server.address.encode(), namespace="collective")
+        group = _DistributedGroup(world_size, server.address, hub=hub)
+        group._server = server  # keep alive with the group
+        group._kv_key = kv_key
+    else:
+        deadline = _time.time() + 30.0
+        addr = None
+        while _time.time() < deadline:
+            raw = gcs.kv_get(kv_key, namespace="collective")
+            if raw:
+                addr = raw.decode()
+                break
+            _time.sleep(0.05)
+        if addr is None:
+            raise TimeoutError(
+                f"rank 0's hub address never appeared for group {group_name}")
+        group = _DistributedGroup(world_size, addr)
+    with _groups_lock:
+        _groups[group_name] = group  # type: ignore[assignment]
+
+
 def destroy_collective_group(group_name: str = "default") -> None:
     with _groups_lock:
-        _groups.pop(group_name, None)
+        state = _groups.pop(group_name, None)
+    server = getattr(state, "_server", None)
+    if server is not None:  # rank 0 of a gloo group hosts the hub
+        server.stop()
+        # Drop the rendezvous key so a re-created group can't race a
+        # later joiner onto the dead hub's address.
+        try:
+            get_runtime().gcs.kv_del(getattr(state, "_kv_key", ""),
+                                     namespace="collective")
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -192,16 +353,13 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default"):
         raise ValueError(f"unknown reduce op {op}")
     state = _group(group_name)
     rank = get_rank(group_name)
-    value = _to_numpy(tensor)
-    return state.exchange(
-        rank, value, lambda slots: _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
-    )
+    return state.exchange_desc(rank, ("allreduce", op), _to_numpy(tensor))
 
 
 def barrier(group_name: str = "default") -> None:
     """reference: collective.py:298."""
     state = _group(group_name)
-    state.exchange(get_rank(group_name), None, lambda slots: None)
+    state.exchange_desc(get_rank(group_name), ("barrier",), None)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
@@ -209,16 +367,14 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     state = _group(group_name)
     rank = get_rank(group_name)
     value = _to_numpy(tensor) if rank == src_rank else None
-    return state.exchange(rank, value, lambda slots: slots[src_rank])
+    return state.exchange_desc(rank, ("broadcast", src_rank), value)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     """reference: collective.py:423. Returns list of per-rank tensors."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange(
-        rank, _to_numpy(tensor), lambda slots: [slots[r] for r in sorted(slots)]
-    )
+    return state.exchange_desc(rank, ("allgather",), _to_numpy(tensor))
 
 
 def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
@@ -228,13 +384,7 @@ def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
         raise ValueError(f"unknown reduce op {op}")
     state = _group(group_name)
     rank = get_rank(group_name)
-    world = state.world_size
-
-    def compute(slots):
-        reduced = _REDUCE_OPS[op]([slots[r] for r in sorted(slots)])
-        return np.array_split(reduced, world, axis=0)
-
-    shards = state.exchange(rank, _to_numpy(tensor), compute)
+    shards = state.exchange_desc(rank, ("reducescatter", op), _to_numpy(tensor))
     return shards[rank]
 
 
@@ -245,32 +395,18 @@ def alltoall(tensor, group_name: str = "default"):
     """
     state = _group(group_name)
     rank = get_rank(group_name)
-    world = state.world_size
-
-    def compute(slots):
-        split = {r: np.array_split(slots[r], world, axis=0) for r in slots}
-        return {r: np.concatenate([split[s][r] for s in sorted(split)], axis=0)
-                for r in range(world)}
-
-    return state.exchange(rank, _to_numpy(tensor), compute)[rank]
+    return state.exchange_desc(rank, ("alltoall",), _to_numpy(tensor))[rank]
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     """reference: collective.py:531 (p2p)."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    with state.cv:
-        state.p2p.setdefault((rank, dst_rank), []).append(_to_numpy(tensor))
-        state.cv.notify_all()
+    state.p2p_send(rank, dst_rank, _to_numpy(tensor))
 
 
 def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
     """reference: collective.py:594 (p2p)."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    key = (src_rank, rank)
-    with state.cv:
-        while not state.p2p.get(key):
-            if not state.cv.wait(timeout=timeout):
-                raise TimeoutError(f"recv from rank {src_rank} timed out")
-        return state.p2p[key].pop(0)
+    return state.p2p_recv(src_rank, rank, timeout)

@@ -193,7 +193,38 @@ class GrpcProxy:
         return self._dumps(result, pickled)
 
     def _handle_stream(self, payload: bytes, context):
+        """Stream items honoring the client's deadline: a drainer thread
+        feeds a queue, and the HANDLER thread (the scarce pool resource)
+        gives up when the deadline passes — a stuck replica may strand the
+        daemon drainer for a while, but never an ingress pool slot."""
+        import queue as _queue
+
         handle, pickled = self._resolve(context)
         value = self._loads(payload, pickled)
-        for item in handle.options(stream=True).remote(value):
+        out: "_queue.Queue" = _queue.Queue()
+        _DONE = object()
+
+        def drain():
+            try:
+                for item in handle.options(stream=True).remote(value):
+                    out.put(item)
+                out.put(_DONE)
+            except BaseException as exc:  # noqa: BLE001 — surface to client
+                out.put(exc)
+
+        threading.Thread(target=drain, daemon=True).start()
+        while True:
+            remaining = context.time_remaining()
+            timeout = min(60.0, remaining) if remaining is not None else 60.0
+            try:
+                item = out.get(timeout=max(0.0, timeout))
+            except _queue.Empty:
+                import grpc
+
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "deployment did not produce an item in time")
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
             yield self._dumps(item, pickled)

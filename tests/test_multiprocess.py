@@ -612,3 +612,72 @@ def test_memory_monitor_kills_newest_task_worker():
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_gloo_collectives_across_processes():
+    """Eager collectives with the cross-process ("gloo") backend: 3 actor
+    PROCESSES rendezvous through the GCS KV and exchange via rank 0's hub
+    (the ray.util.collective gloo-group analog)."""
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 3})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            class Member:
+                def __init__(self, rank, world):
+                    from ray_tpu.parallel import collectives as c
+
+                    c.init_collective_group(world, rank, backend="gloo",
+                                            group_name="xp")
+                    self.rank = rank
+                    self.world = world
+
+                def round_trip(self):
+                    import numpy as np
+
+                    from ray_tpu.parallel import collectives as c
+
+                    total = c.allreduce(np.array([self.rank + 1.0]),
+                                        group_name="xp")
+                    gathered = c.allgather(np.array([self.rank]),
+                                           group_name="xp")
+                    root = c.broadcast(
+                        np.array([42.0]) if self.rank == 0 else None,
+                        src_rank=0, group_name="xp")
+                    return (float(total[0]),
+                            [int(g[0]) for g in gathered],
+                            float(root[0]),
+                            os.getpid())
+
+                def p2p(self):
+                    import numpy as np
+
+                    from ray_tpu.parallel import collectives as c
+
+                    if self.rank == 0:
+                        c.send(np.array([7.0]), dst_rank=2, group_name="xp")
+                        return None
+                    if self.rank == 2:
+                        got = c.recv(0, group_name="xp", timeout=60)
+                        return float(got[0])
+                    return None
+
+            world = 3
+            members = [Member.options(num_cpus=1).remote(r, world)
+                       for r in range(world)]
+            # All ranks must run the collective concurrently.
+            results = ray_tpu.get(
+                [m.round_trip.remote() for m in members], timeout=180)
+            pids = {r[3] for r in results}
+            assert len(pids) == world, "members must be distinct processes"
+            for total, gathered, root, _pid in results:
+                assert total == 6.0          # 1 + 2 + 3
+                assert gathered == [0, 1, 2]
+                assert root == 42.0
+            p2p = ray_tpu.get([m.p2p.remote() for m in members], timeout=120)
+            assert p2p[2] == 7.0
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
