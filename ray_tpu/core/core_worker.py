@@ -278,6 +278,11 @@ class CoreWorker:
         # Execution context (worker mode fills these per task).
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
+        # Blocked-worker protocol hooks (worker_main wires these): called
+        # when a get() blocks >50ms / when it unblocks, to release and
+        # reacquire the running task's lease.
+        self.blocked_on_get = None
+        self.unblocked_after_get = None
         self._shutdown = False
 
     # ====================== objects ======================
@@ -330,64 +335,84 @@ class CoreWorker:
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
         deadline = time.time() + timeout if timeout is not None else None
         values = []
-        for r in ref_list:
-            value = self._get_one(r, deadline)
-            if isinstance(value, TaskError):
-                raise value.as_instanceof_cause()
-            if isinstance(value, (TaskCancelledError, ActorError)):
-                raise value
-            values.append(value)
+        try:
+            for r in ref_list:
+                value = self._get_one(r, deadline)
+                if isinstance(value, TaskError):
+                    raise value.as_instanceof_cause()
+                if isinstance(value, (TaskCancelledError, ActorError)):
+                    raise value
+                values.append(value)
+        finally:
+            # Blocked-worker protocol: _get_one only ever RELEASES the
+            # running task's lease; reacquire once per get() batch, not per
+            # ref (hooks are idempotent no-ops when nothing was released).
+            if self.unblocked_after_get is not None:
+                self.unblocked_after_get()
         return values[0] if single else values
 
     def _get_one(self, ref: ObjectRef, deadline: float | None):
+        """Resolve one ref; while BLOCKED in a worker, the task's lease is
+        released so nested tasks can't deadlock a fully leased cluster
+        (the reference's blocked-worker CPU release), and reacquired on the
+        same node before returning."""
         oid = ref.id
         backoff = 0.001
         missing_since: float | None = None
         recovered = False
+        started = time.time()
+        notified_blocked = False
         while True:
-            with self._cache_lock:
-                if oid in self._cache:
-                    return self._cache[oid]
-                pending = self._pending.get(oid)
-            if pending is not None:
-                remaining = None if deadline is None else deadline - time.time()
-                if remaining is not None and remaining <= 0:
-                    raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-                pending.done.wait(timeout=remaining if remaining is not None
-                                  else 1.0)
-                with self._cache_lock:
-                    if oid in self._cache:
-                        return self._cache[oid]
-                if pending.done.is_set():
-                    # Completed but not cached here (e.g. ref from another
-                    # process path) — fall through to the fetch path.
-                    pass
-            value = self._try_fetch(oid)
-            if value is not _MISSING:
-                with self._cache_cv:
-                    self._cache[oid] = value
-                    self._cache_cv.notify_all()
-                return value
-            # Lineage-based recovery (object_recovery_manager.h:41): the
-            # object has no live replica — if the GCS kept its creating
-            # TaskSpec, resubmit it once; the re-executed task re-seals the
-            # same return ids. Brief grace first (a fresh task's seal may
-            # not have landed), then probe the lineage table at most once
-            # per second so waiting consumers don't hot-loop the GCS.
-            now = time.time()
-            missing_since = missing_since or now
-            if (not recovered and pending is None
-                    and now - missing_since > 0.5
-                    and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
-                self._last_lineage_probe = now
-                if self._maybe_recover(oid):
-                    recovered = True
-                    missing_since = None
-                    continue
-            if deadline is not None and time.time() >= deadline:
-                raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 0.1)
+          if (not notified_blocked
+                  and self.blocked_on_get is not None
+                  and time.time() - started > 0.05):
+              notified_blocked = True
+              self.blocked_on_get()
+          with self._cache_lock:
+              if oid in self._cache:
+                  return self._cache[oid]
+              pending = self._pending.get(oid)
+          if pending is not None:
+              remaining = None if deadline is None else deadline - time.time()
+              if remaining is not None and remaining <= 0:
+                  raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+              # Bounded slices so the loop re-checks the blocked-worker
+              # hook (a full-deadline wait would never release the lease).
+              pending.done.wait(timeout=min(remaining, 1.0)
+                                if remaining is not None else 1.0)
+              with self._cache_lock:
+                  if oid in self._cache:
+                      return self._cache[oid]
+              if pending.done.is_set():
+                  # Completed but not cached here (e.g. ref from another
+                  # process path) — fall through to the fetch path.
+                  pass
+          value = self._try_fetch(oid)
+          if value is not _MISSING:
+              with self._cache_cv:
+                  self._cache[oid] = value
+                  self._cache_cv.notify_all()
+              return value
+          # Lineage-based recovery (object_recovery_manager.h:41): the
+          # object has no live replica — if the GCS kept its creating
+          # TaskSpec, resubmit it once; the re-executed task re-seals the
+          # same return ids. Brief grace first (a fresh task's seal may
+          # not have landed), then probe the lineage table at most once
+          # per second so waiting consumers don't hot-loop the GCS.
+          now = time.time()
+          missing_since = missing_since or now
+          if (not recovered and pending is None
+                  and now - missing_since > 0.5
+                  and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
+              self._last_lineage_probe = now
+              if self._maybe_recover(oid):
+                  recovered = True
+                  missing_since = None
+                  continue
+          if deadline is not None and time.time() >= deadline:
+              raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+          time.sleep(backoff)
+          backoff = min(backoff * 2, 0.1)
 
     def _maybe_recover(self, oid: ObjectID) -> bool:
         """Resubmit the task that created ``oid`` (lineage reconstruction)."""
@@ -553,9 +578,7 @@ class CoreWorker:
 
     def _run_submission_inner(self, spec: TaskSpec, pending: _PendingTask) -> None:
         spec_bytes = serialization.dumps(spec)
-        resources = dict(spec.options.resources)
-        if spec.task_type == TaskType.NORMAL_TASK and "CPU" not in resources:
-            resources["CPU"] = 1.0
+        resources = spec.declared_resources()
         max_retries = spec.options.max_retries
         attempt = 0
         try:

@@ -113,6 +113,8 @@ class NodeDaemon:
         self._idle: List[_Worker] = []
         self._spawn_pending = 0  # spawned but not yet registered
         self._demand = 0  # _pop_worker calls currently waiting
+        # Worker's CURRENT task lease (may swap during blocked-release).
+        self._worker_lease: Dict[WorkerID, Optional[str]] = {}
         # Session log dir: per-worker stdout/stderr files, tailed into the
         # GCS "logs" pubsub channel (log_monitor.py analog).
         self._log_dir = os.path.join(
@@ -272,6 +274,15 @@ class NodeDaemon:
             worker.busy = True
             return worker
 
+    def update_worker_lease(self, worker_id: WorkerID,
+                            lease_id: Optional[str]) -> None:
+        """Worker reports a lease swap (blocked-release/reacquire) so a
+        mid-task death releases the RIGHT lease. None = worker released it
+        itself and holds nothing."""
+        with self._pool_lock:
+            if worker_id in self._workers:
+                self._worker_lease[worker_id] = lease_id
+
     def register_worker(self, worker_id: WorkerID, address: str) -> None:
         """Called by a freshly started worker process once its server is up."""
         with self._pool_cv:
@@ -351,6 +362,12 @@ class NodeDaemon:
                     self._pool_cv.notify_all()
             for worker in dead:
                 rc = worker.proc.returncode
+                with self._pool_lock:
+                    orphan_lease = self._worker_lease.pop(worker.worker_id, None)
+                if orphan_lease is not None:
+                    # Task worker died mid-lease (possibly a swapped one
+                    # from blocked-release) — free the resources.
+                    self._release(orphan_lease)
                 if worker.actor_id is not None:
                     with self._pool_lock:
                         self._actor_records.pop(worker.actor_id, None)
@@ -385,16 +402,32 @@ class NodeDaemon:
             self._release(lease_id)
             raise WorkerDiedError(f"worker pool exhausted: {e}") from e
         broken = False
+        with self._pool_lock:
+            self._worker_lease[worker.worker_id] = lease_id
         try:
-            result = worker.client.call("run_task", spec_bytes, timeout=None)
+            result = worker.client.call("run_task", spec_bytes, lease_id,
+                                        timeout=None)
+            # IN-BAND final lease: blocked-release may have swapped or shed
+            # the grant mid-task; the reply says what the worker holds NOW
+            # (deterministic — the side-channel notify only races crashes).
+            with self._pool_lock:
+                self._worker_lease.pop(worker.worker_id, None)
+            final = result.pop("final_lease_id", lease_id)
+            if final is not None:
+                self._release(final)
             return result
         except RpcConnectionError as e:
             broken = True
+            # Crash path: release whatever the side-channel notes last
+            # recorded for this worker (may be a swapped lease).
+            with self._pool_lock:
+                current = self._worker_lease.pop(worker.worker_id, lease_id)
+            if current is not None:
+                self._release(current)
             raise WorkerDiedError(
                 f"worker died while running task: {e}"
             ) from e
         finally:
-            self._release(lease_id)
             if broken:
                 # Never return a worker whose channel broke: its process is
                 # dead or wedged. Kill it so the reaper collects it instead

@@ -138,6 +138,15 @@ class TaskSpec:
         )
         return [ObjectID.for_task_return(self.task_id, i) for i in range(n)]
 
+    def declared_resources(self) -> Dict[str, float]:
+        """The task's effective resource footprint (normal tasks imply
+        CPU=1) — ONE definition shared by submission-side lease requests and
+        the worker's blocked-release reacquire, so they can never drift."""
+        resources = dict(self.options.resources)
+        if self.task_type == TaskType.NORMAL_TASK and "CPU" not in resources:
+            resources["CPU"] = 1.0
+        return resources
+
     def dependencies(self) -> List[ObjectID]:
         deps = [a.object_id for a in self.args if a.is_ref]
         deps += [a.object_id for a in self.kwargs.values() if a.is_ref]

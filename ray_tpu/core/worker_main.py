@@ -68,30 +68,85 @@ class _ActorState:
 class WorkerService:
     """RPC surface pushed to by the daemon (tasks) and callers (actor tasks)."""
 
-    def __init__(self, core: CoreWorker):
+    def __init__(self, core: CoreWorker, worker_id=None, daemon_client=None):
         self.core = core
+        self.worker_id = worker_id
+        self._daemon = daemon_client
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
+        self._task_lease = threading.local()
+        # Blocked-worker protocol (reference: CPU released while a worker
+        # blocks in ray.get — worker.py release/reacquire; prevents nested
+        # task deadlock on a fully leased cluster).
+        core.blocked_on_get = self._release_lease_while_blocked
+        core.unblocked_after_get = self._reacquire_lease
+
+    def _release_lease_while_blocked(self) -> None:
+        st = getattr(self._task_lease, "value", None)
+        if not st or st["released"] or st["lease_id"] is None:
+            return
+        try:
+            self.core._gcs_rpc.notify("release_lease", st["lease_id"])
+        except RpcConnectionError:
+            return
+        # The GCS notify is the authoritative release — mark it NOW so a
+        # failed (best-effort) daemon note can't leave us running without a
+        # lease and never reacquiring.
+        st["released"] = True
+        if self._daemon is not None:
+            try:
+                self._daemon.notify("update_worker_lease", self.worker_id, None)
+            except RpcConnectionError:
+                pass
+
+    def _reacquire_lease(self) -> None:
+        st = getattr(self._task_lease, "value", None)
+        if not st or not st["released"]:
+            return
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+        strategy = NodeAffinitySchedulingStrategy(
+            node_id=self.core.current_node_id, soft=False)
+        lease_id, _node, _addr = self.core._request_lease(
+            st["resources"], strategy)
+        st["lease_id"] = lease_id
+        st["released"] = False
+        if self._daemon is not None:
+            try:
+                self._daemon.notify("update_worker_lease", self.worker_id,
+                                    lease_id)
+            except RpcConnectionError:
+                pass
 
     # ====================== normal tasks ======================
 
-    def run_task(self, spec_bytes: bytes) -> dict:
+    def run_task(self, spec_bytes: bytes, lease_id: str | None = None) -> dict:
         spec: TaskSpec = serialization.loads(spec_bytes)
         self.core.current_task_id = spec.task_id
+        st = {"lease_id": lease_id,
+              "resources": spec.declared_resources(), "released": False}
+        self._task_lease.value = st
         try:
             fn = self.core.gcs.get_function(spec.function_id)
             if fn is None:
                 raise RuntimeError(f"function {spec.function_id} not in GCS")
             args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
-            return self._package_results(spec, result, lineage=spec_bytes)
+            out = self._package_results(spec, result, lineage=spec_bytes)
         except _DependencyFailed as df:
-            return self._package_error(spec, df.error)
+            out = self._package_error(spec, df.error)
         except BaseException as exc:  # noqa: BLE001 — wire to the caller
-            return self._package_error(
+            out = self._package_error(
                 spec, TaskError.from_exception(spec.function_name, exc))
         finally:
+            self._task_lease.value = None
             self.core.current_task_id = None
+        # IN-BAND lease report: blocked-release may have swapped (or shed)
+        # the lease mid-task; telling the daemon in the reply — the same
+        # channel it releases on — makes the ordering deterministic (the
+        # side-channel notify only covers the worker-crash case).
+        out["final_lease_id"] = None if st["released"] else st["lease_id"]
+        return out
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         def resolve(arg):
@@ -102,8 +157,14 @@ class WorkerService:
                 return value
             return arg.value
 
-        args = [resolve(a) for a in spec.args]
-        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        try:
+            args = [resolve(a) for a in spec.args]
+            kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        finally:
+            # One reacquire for the whole dependency batch (the hooks are
+            # idempotent; _get_one only releases).
+            if self.core.unblocked_after_get is not None:
+                self.core.unblocked_after_get()
         return args, kwargs
 
     def _package_results(self, spec: TaskSpec, result,
@@ -326,9 +387,9 @@ def main() -> int:
 
     runtime_mod._global_runtime = core
 
-    service = WorkerService(core)
-    server = RpcServer(service, name=f"worker-{worker_id.hex()[:8]}")
     daemon = RpcClient(daemon_address)
+    service = WorkerService(core, worker_id=worker_id, daemon_client=daemon)
+    server = RpcServer(service, name=f"worker-{worker_id.hex()[:8]}")
     daemon.call("register_worker", worker_id, server.address)
 
     # Watchdog: the daemon is this process's reason to live. If it goes away

@@ -194,37 +194,60 @@ class GrpcProxy:
 
     def _handle_stream(self, payload: bytes, context):
         """Stream items honoring the client's deadline: a drainer thread
-        feeds a queue, and the HANDLER thread (the scarce pool resource)
-        gives up when the deadline passes — a stuck replica may strand the
-        daemon drainer for a while, but never an ingress pool slot."""
+        feeds a BOUNDED queue (backpressure: a fast replica can't flood the
+        ingress), and the HANDLER thread (the scarce pool resource) gives up
+        only when the client's actual deadline expires — a stuck replica may
+        strand the daemon drainer for a while, but never a pool slot."""
         import queue as _queue
 
         handle, pickled = self._resolve(context)
         value = self._loads(payload, pickled)
-        out: "_queue.Queue" = _queue.Queue()
+        out: "_queue.Queue" = _queue.Queue(maxsize=16)
+        done_serving = threading.Event()
         _DONE = object()
 
         def drain():
             try:
                 for item in handle.options(stream=True).remote(value):
-                    out.put(item)
-                out.put(_DONE)
+                    while not done_serving.is_set():
+                        try:
+                            out.put(item, timeout=1.0)
+                            break
+                        except _queue.Full:
+                            continue
+                    if done_serving.is_set():
+                        return  # client gone: stop consuming the replica
+                while not done_serving.is_set():
+                    try:
+                        out.put(_DONE, timeout=1.0)
+                        return
+                    except _queue.Full:
+                        continue
             except BaseException as exc:  # noqa: BLE001 — surface to client
-                out.put(exc)
+                if not done_serving.is_set():
+                    out.put(exc)
 
         threading.Thread(target=drain, daemon=True).start()
-        while True:
-            remaining = context.time_remaining()
-            timeout = min(60.0, remaining) if remaining is not None else 60.0
-            try:
-                item = out.get(timeout=max(0.0, timeout))
-            except _queue.Empty:
-                import grpc
+        try:
+            while True:
+                remaining = context.time_remaining()
+                if remaining is not None and remaining <= 0:
+                    import grpc
 
-                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
-                              "deployment did not produce an item in time")
-            if item is _DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield self._dumps(item, pickled)
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  "client deadline expired mid-stream")
+                # Poll slices just pace deadline checks — a long gap
+                # between items is NOT an error without a client deadline.
+                slice_s = (min(5.0, max(0.0, remaining))
+                           if remaining is not None else 5.0)
+                try:
+                    item = out.get(timeout=slice_s)
+                except _queue.Empty:
+                    continue
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield self._dumps(item, pickled)
+        finally:
+            done_serving.set()

@@ -681,3 +681,30 @@ def test_gloo_collectives_across_processes():
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_nested_tasks_no_deadlock_when_fully_leased():
+    """Blocked-worker release: outer tasks saturate every CPU lease, then
+    each spawns an inner task and blocks in get() — without releasing the
+    outer leases this deadlocks; with the protocol it completes."""
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            def inner(x):
+                return x * 10
+
+            @ray_tpu.remote
+            def outer(x):
+                return ray_tpu.get(inner.remote(x), timeout=150) + 1
+
+            # 2 CPUs, 2 outer tasks -> both leases taken before either
+            # inner can schedule.
+            refs = [outer.remote(i) for i in range(2)]
+            assert sorted(ray_tpu.get(refs, timeout=240)) == [1, 11]
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
