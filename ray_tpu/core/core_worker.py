@@ -363,56 +363,56 @@ class CoreWorker:
         started = time.time()
         notified_blocked = False
         while True:
-          if (not notified_blocked
-                  and self.blocked_on_get is not None
-                  and time.time() - started > 0.05):
-              notified_blocked = True
-              self.blocked_on_get()
-          with self._cache_lock:
-              if oid in self._cache:
-                  return self._cache[oid]
-              pending = self._pending.get(oid)
-          if pending is not None:
-              remaining = None if deadline is None else deadline - time.time()
-              if remaining is not None and remaining <= 0:
-                  raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-              # Bounded slices so the loop re-checks the blocked-worker
-              # hook (a full-deadline wait would never release the lease).
-              pending.done.wait(timeout=min(remaining, 1.0)
-                                if remaining is not None else 1.0)
-              with self._cache_lock:
-                  if oid in self._cache:
-                      return self._cache[oid]
-              if pending.done.is_set():
-                  # Completed but not cached here (e.g. ref from another
-                  # process path) — fall through to the fetch path.
-                  pass
-          value = self._try_fetch(oid)
-          if value is not _MISSING:
-              with self._cache_cv:
-                  self._cache[oid] = value
-                  self._cache_cv.notify_all()
-              return value
-          # Lineage-based recovery (object_recovery_manager.h:41): the
-          # object has no live replica — if the GCS kept its creating
-          # TaskSpec, resubmit it once; the re-executed task re-seals the
-          # same return ids. Brief grace first (a fresh task's seal may
-          # not have landed), then probe the lineage table at most once
-          # per second so waiting consumers don't hot-loop the GCS.
-          now = time.time()
-          missing_since = missing_since or now
-          if (not recovered and pending is None
-                  and now - missing_since > 0.5
-                  and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
-              self._last_lineage_probe = now
-              if self._maybe_recover(oid):
-                  recovered = True
-                  missing_since = None
-                  continue
-          if deadline is not None and time.time() >= deadline:
-              raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-          time.sleep(backoff)
-          backoff = min(backoff * 2, 0.1)
+            if (not notified_blocked
+                    and self.blocked_on_get is not None
+                    and time.time() - started > 0.05):
+                notified_blocked = True
+                self.blocked_on_get()
+            with self._cache_lock:
+                if oid in self._cache:
+                    return self._cache[oid]
+                pending = self._pending.get(oid)
+            if pending is not None:
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+                # Bounded slices so the loop re-checks the blocked-worker
+                # hook (a full-deadline wait would never release the lease).
+                pending.done.wait(timeout=min(remaining, 1.0)
+                                  if remaining is not None else 1.0)
+                with self._cache_lock:
+                    if oid in self._cache:
+                        return self._cache[oid]
+                if pending.done.is_set():
+                    # Completed but not cached here (e.g. ref from another
+                    # process path) — fall through to the fetch path.
+                    pass
+            value = self._try_fetch(oid)
+            if value is not _MISSING:
+                with self._cache_cv:
+                    self._cache[oid] = value
+                    self._cache_cv.notify_all()
+                return value
+            # Lineage-based recovery (object_recovery_manager.h:41): the
+            # object has no live replica — if the GCS kept its creating
+            # TaskSpec, resubmit it once; the re-executed task re-seals the
+            # same return ids. Brief grace first (a fresh task's seal may
+            # not have landed), then probe the lineage table at most once
+            # per second so waiting consumers don't hot-loop the GCS.
+            now = time.time()
+            missing_since = missing_since or now
+            if (not recovered and pending is None
+                    and now - missing_since > 0.5
+                    and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
+                self._last_lineage_probe = now
+                if self._maybe_recover(oid):
+                    recovered = True
+                    missing_since = None
+                    continue
+            if deadline is not None and time.time() >= deadline:
+                raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
 
     def _maybe_recover(self, oid: ObjectID) -> bool:
         """Resubmit the task that created ``oid`` (lineage reconstruction)."""

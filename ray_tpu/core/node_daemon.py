@@ -316,8 +316,15 @@ class NodeDaemon:
                         if worker.proc.poll() is None:
                             worker.busy = True
                             return worker
-                    live = sum(1 for w in self._workers.values()
-                               if w.proc.poll() is None)
+                    # Workers that RELEASED their lease while blocked in a
+                    # nested get (map entry is None) don't count against the
+                    # cap — otherwise deep nesting wedges on pool slots with
+                    # CPUs logically free (the reference grows its pool for
+                    # blocked workers the same way).
+                    live = sum(
+                        1 for w in self._workers.values()
+                        if w.proc.poll() is None
+                        and self._worker_lease.get(w.worker_id, "idle") is not None)
                     if (live + self._spawn_pending < self._max_workers
                             and self._spawn_pending < self._demand):
                         self._spawn_worker()
@@ -418,16 +425,21 @@ class NodeDaemon:
             return result
         except RpcConnectionError as e:
             broken = True
-            # Crash path: release whatever the side-channel notes last
-            # recorded for this worker (may be a swapped lease).
-            with self._pool_lock:
-                current = self._worker_lease.pop(worker.worker_id, lease_id)
-            if current is not None:
-                self._release(current)
             raise WorkerDiedError(
                 f"worker died while running task: {e}"
             ) from e
+        except BaseException:
+            broken = True  # unknown channel state: don't reuse the worker
+            raise
         finally:
+            if broken:
+                # Exceptional paths (conn loss, frame errors, pre-task
+                # failures): release whatever the side-channel notes last
+                # recorded — the lease must never outlive the attempt.
+                with self._pool_lock:
+                    current = self._worker_lease.pop(worker.worker_id, lease_id)
+                if current is not None:
+                    self._release(current)
             if broken:
                 # Never return a worker whose channel broke: its process is
                 # dead or wedged. Kill it so the reaper collects it instead

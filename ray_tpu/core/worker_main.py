@@ -100,9 +100,18 @@ class WorkerService:
                 pass
 
     def _reacquire_lease(self) -> None:
+        """Idempotent; called from get()-batch finallys. Failures are
+        swallowed (released stays True, so the NEXT batch retries) — a
+        transient GCS outage must never clobber an already-fetched value."""
         st = getattr(self._task_lease, "value", None)
         if not st or not st["released"]:
             return
+        try:
+            self._reacquire_lease_inner(st)
+        except Exception:  # noqa: BLE001 — retried on the next get batch
+            logger.warning("lease reacquire failed; will retry next get")
+
+    def _reacquire_lease_inner(self, st) -> None:
         from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
 
         strategy = NodeAffinitySchedulingStrategy(
@@ -112,10 +121,14 @@ class WorkerService:
         st["lease_id"] = lease_id
         st["released"] = False
         if self._daemon is not None:
+            # BLOCKING call (not a note): the daemon must know about the new
+            # lease before we resume work, shrinking the crash window in
+            # which a reacquired lease exists that nobody could release to
+            # the instant between grant and this call.
             try:
-                self._daemon.notify("update_worker_lease", self.worker_id,
-                                    lease_id)
-            except RpcConnectionError:
+                self._daemon.call("update_worker_lease", self.worker_id,
+                                  lease_id, timeout=10.0)
+            except (RpcConnectionError, TimeoutError):
                 pass
 
     # ====================== normal tasks ======================

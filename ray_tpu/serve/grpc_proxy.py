@@ -224,8 +224,12 @@ class GrpcProxy:
                     except _queue.Full:
                         continue
             except BaseException as exc:  # noqa: BLE001 — surface to client
-                if not done_serving.is_set():
-                    out.put(exc)
+                while not done_serving.is_set():
+                    try:
+                        out.put(exc, timeout=1.0)
+                        return
+                    except _queue.Full:
+                        continue
 
         threading.Thread(target=drain, daemon=True).start()
         try:

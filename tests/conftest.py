@@ -84,3 +84,30 @@ def cpu_mesh_devices():
     devices = jax.devices("cpu")
     assert len(devices) >= 8, "conftest must force 8 host-platform devices"
     return devices
+
+
+def _sweep_stale_shm() -> None:
+    """Remove shm arenas left by SIGKILLed test processes (crash tests kill
+    whole interpreters, skipping store destructors). Names embed the owning
+    pid — only arenas of DEAD processes are removed."""
+    import re
+
+    if not os.path.isdir("/dev/shm"):
+        return
+    for name in os.listdir("/dev/shm"):
+        pid_m = re.match(r"rtpu_store_(\d+)_", name)
+        if not pid_m:
+            continue
+        pid = int(pid_m.group(1))
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
+        except PermissionError:
+            pass
+
+
+_sweep_stale_shm()
