@@ -199,12 +199,13 @@ class _LocalRefCounter:
 
 
 class _PendingTask:
-    __slots__ = ("refs", "done", "error")
+    __slots__ = ("refs", "done", "error", "cancelled")
 
     def __init__(self, refs: List[ObjectID]):
         self.refs = refs
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
+        self.cancelled = False  # results arriving after cancel() are dropped
 
 
 class CoreWorker:
@@ -583,6 +584,11 @@ class CoreWorker:
         attempt = 0
         try:
             while True:
+                if pending.cancelled:
+                    # cancel() already sealed TaskCancelledError; don't lease
+                    # or (re-)execute work the user gave up on.
+                    pending.done.set()
+                    return
                 attempt += 1
                 try:
                     lease_id, node_id, node_addr = self._request_lease(
@@ -641,6 +647,14 @@ class CoreWorker:
                              result: dict) -> None:
         returns: List[Tuple[bytes, Optional[bytes]]] = result["returns"]
         with self._cache_cv:
+            if pending.cancelled:
+                # cancel() already sealed TaskCancelledError into the cache;
+                # a late real result must not race it back to a value.
+                for oid in pending.refs:
+                    self._pending.pop(oid, None)
+                self._cache_cv.notify_all()
+                pending.done.set()
+                return
             for oid_bytes, inline in returns:
                 if inline is not None:
                     self._cache[ObjectID(oid_bytes)] = serialization.loads(inline)
@@ -656,6 +670,12 @@ class CoreWorker:
     def _record_task_error(self, spec: TaskSpec, pending: _PendingTask,
                            error) -> None:
         with self._cache_cv:
+            if pending.cancelled:
+                for oid in pending.refs:
+                    self._pending.pop(oid, None)
+                self._cache_cv.notify_all()
+                pending.done.set()
+                return
             for oid in pending.refs:
                 self._cache[oid] = error
                 self._pending.pop(oid, None)
@@ -812,12 +832,18 @@ class CoreWorker:
         self._gcs_rpc.call("kill_actor", actor_id, no_restart)
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
-        """Best-effort cancel: only not-yet-completed tasks are affected."""
-        with self._cache_lock:
+        """Best-effort cancel: only not-yet-completed tasks are affected.
+
+        Marking ``pending.cancelled`` under the cache lock makes the outcome
+        deterministic: either the task completed first (value stays) or the
+        cancel landed first and a late result is dropped by
+        ``_record_task_results`` — never both racing into the cache.
+        """
+        with self._cache_cv:
             pending = self._pending.get(ref.id)
-        if pending is not None and not pending.done.is_set():
-            error = TaskCancelledError(ref.id.task_id())
-            with self._cache_cv:
+            if pending is not None and not pending.done.is_set():
+                pending.cancelled = True
+                error = TaskCancelledError(ref.id.task_id())
                 for oid in pending.refs:
                     if oid not in self._cache:
                         self._cache[oid] = error

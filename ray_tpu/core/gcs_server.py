@@ -274,6 +274,16 @@ class GcsService:
                         f"no feasible node: hard affinity to dead node "
                         f"{strategy.node_id}")
                 if pg_id is not None:
+                    if pg_id not in self._pgs:
+                        # Group removed (remove_placement_group pops it) —
+                        # indistinguishable from "temporarily full" inside
+                        # _try_pg_lease, so fail fast here instead of
+                        # spinning out the whole timeout. Creation blocks
+                        # before handles exist, so "not yet created" can't
+                        # reach this path.
+                        raise RuntimeError(
+                            f"placement group {pg_id} does not exist "
+                            "(removed?)")
                     got = self._try_pg_lease(pg_id, bundle_index, request)
                 else:
                     got = self._try_lease(request, strategy)
@@ -568,7 +578,7 @@ class GcsService:
         if node is not None and addr is not None:
             try:
                 self._daemons.get(node).call("kill_actor_worker", actor_id,
-                                             timeout=10.0)
+                                             no_restart, timeout=10.0)
             except Exception:  # noqa: BLE001 — death report arrives via daemon reaper
                 logger.info("kill_actor: daemon unreachable for %s", actor_id.hex()[:8])
         if no_restart:

@@ -65,7 +65,7 @@ def _memory_usage_fraction() -> Optional[float]:
 
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy",
-                 "env_key")
+                 "env_key", "spawned_at")
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen,
                  env_key: Optional[str] = None):
@@ -76,6 +76,9 @@ class _Worker:
         self.actor_id: Optional[ActorID] = None  # dedicated to an actor
         self.busy = False
         self.env_key = env_key  # runtime_env hash; None = vanilla pool
+        # OOM policy: newest-spawned dies first. Monotonic — a wall-clock
+        # step must not invert the ordering.
+        self.spawned_at = time.monotonic()
 
 
 class NodeDaemon:
@@ -497,13 +500,17 @@ class NodeDaemon:
             self._actor_records[spec.actor_id] = (spec_bytes, worker.address)
         return worker.address
 
-    def kill_actor_worker(self, actor_id: ActorID) -> bool:
+    def kill_actor_worker(self, actor_id: ActorID,
+                          no_restart: bool = True) -> bool:
         with self._pool_lock:
             target = next((w for w in self._workers.values()
                            if w.actor_id == actor_id), None)
-            if target is not None:
+            if target is not None and no_restart:
                 # Forget the actor binding so the reaper doesn't report this
-                # intentional kill as a failure needing restart.
+                # intentional kill as a failure needing restart. With
+                # no_restart=False the binding stays: the reaper reports the
+                # death and the GCS restart ladder (which also releases the
+                # lifetime lease) runs exactly as for a crash.
                 target.actor_id = None
                 self._actor_records.pop(actor_id, None)
         if target is None:
@@ -652,7 +659,9 @@ class NodeDaemon:
                               if w.busy and w.actor_id is None
                               and w.proc.poll() is None]
                 if busy_tasks:
-                    victim = max(busy_tasks, key=lambda w: w.proc.pid)
+                    # Spawn timestamp, not pid: pids wrap around and pid
+                    # namespaces reuse, so max(pid) can pick an old worker.
+                    victim = max(busy_tasks, key=lambda w: w.spawned_at)
             if victim is not None:
                 logger.warning(
                     "node memory %.0f%% >= %.0f%% — killing newest task "

@@ -17,6 +17,13 @@ Wire format, one frame per message::
 Requests multiplex over one connection: each carries a request id and replies
 may arrive out of order (the reference gets this from HTTP/2 streams; we get
 it from a reader thread matching ids to futures).
+
+Security: frames are pickled, so any peer that can connect gets arbitrary
+code execution — bind ``--host`` to loopback or a mesh-internal interface
+ONLY. For non-loopback bindings set ``RAY_TPU_AUTH_TOKEN`` (propagated to
+every spawned cluster process like the other ``RAY_TPU_*`` vars): each
+connection must then open with a matching token frame before any request is
+read; mismatches close the socket without unpickling anything else.
 """
 
 from __future__ import annotations
@@ -34,6 +41,13 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger("rpc")
 
 _LEN = struct.Struct(">Q")
+_AUTH_MAGIC = b"RTPU-AUTH1"
+
+
+def _auth_token() -> bytes:
+    import os
+
+    return os.environ.get("RAY_TPU_AUTH_TOKEN", "").encode()
 # Hard cap on a single frame (control messages are small; sealed objects can
 # be fetched in one frame — match the reference's practical object sizes).
 MAX_FRAME = 16 * 1024 * 1024 * 1024
@@ -99,9 +113,11 @@ class RpcServer:
     """
 
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 64, name: str = "rpc"):
+                 max_workers: int = 64, name: str = "rpc",
+                 auth_token: Optional[bytes] = None):
         self._handler = handler
         self._name = name
+        self._token = _auth_token() if auth_token is None else auth_token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -134,6 +150,21 @@ class RpcServer:
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
         try:
+            token = self._token
+            if token:
+                # First frame must be the raw (unpickled!) auth blob;
+                # anything else — wrong token, or a peer without one —
+                # closes the socket before pickle ever sees peer bytes.
+                import hmac
+
+                (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                if length > 4096:
+                    raise RpcConnectionError("oversized auth frame")
+                blob = _recv_exact(conn, length)
+                if not hmac.compare_digest(blob, _AUTH_MAGIC + token):
+                    logger.warning("%s: rejected connection with bad auth "
+                                   "token", self._name)
+                    raise RpcConnectionError("bad auth token")
             while not self._stopped.is_set():
                 kind, req_id, method, data = _recv_frame(conn)
                 if kind == "note":
@@ -207,9 +238,11 @@ class RpcClient:
     as core-worker transports do in the reference).
     """
 
-    def __init__(self, address: str, connect_timeout: float = 10.0):
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 auth_token: Optional[bytes] = None):
         self.address = address
         self._timeout = connect_timeout
+        self._token = _auth_token() if auth_token is None else auth_token
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -235,6 +268,15 @@ class RpcClient:
                 ) from e
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            token = self._token
+            if token:
+                blob = _AUTH_MAGIC + token
+                try:
+                    sock.sendall(_LEN.pack(len(blob)) + blob)
+                except OSError as e:
+                    raise RpcConnectionError(
+                        f"auth handshake to {self.address} failed: {e}"
+                    ) from e
             self._sock = sock
             threading.Thread(
                 target=self._read_loop, args=(sock,),

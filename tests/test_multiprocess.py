@@ -208,6 +208,64 @@ def test_kill_actor(driver):
     )
 
 
+def test_kill_actor_with_restart(driver):
+    """kill(no_restart=False) runs the restart ladder on the multiprocess
+    runtime: the daemon keeps the actor binding so its reaper reports the
+    death and the GCS reschedules (and releases the old lifetime lease)."""
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix0:
+        def pid(self):
+            return os.getpid()
+
+    a = Phoenix0.remote()
+    p1 = ray_tpu.get(a.pid.remote(), timeout=60)
+    ray_tpu.kill(a, no_restart=False)
+    p2 = ray_tpu.get(a.pid.remote(), timeout=120)
+    assert p2 != p1
+
+
+def test_cancel_sticks_after_task_completes(driver):
+    """cancel() marks the pending task; a late real result must not race
+    the cancellation error back to a value (get stays deterministic)."""
+    @ray_tpu.remote
+    def slowish():
+        time.sleep(2.0)
+        return 42
+
+    ref = slowish.remote()
+    time.sleep(0.3)  # in flight
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    time.sleep(2.5)  # the worker finishes the task anyway
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_lease_for_removed_pg_fails_fast(driver):
+    """A lease against a removed placement group raises promptly instead of
+    spinning out the full scheduling timeout."""
+    from ray_tpu.core.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+    from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+    remove_placement_group(pg)
+
+    @ray_tpu.remote(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg))
+    def where():
+        return "ran"
+
+    start = time.time()
+    with pytest.raises(Exception, match="does not exist"):
+        ray_tpu.get(where.remote(), timeout=60)
+    assert time.time() - start < 30.0
+
+
 # ====================== fault tolerance (kill -9) ======================
 
 
