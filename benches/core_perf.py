@@ -1,0 +1,218 @@
+"""Core runtime microbenchmarks — the ray_perf analog.
+
+Mirrors the reference's microbenchmark suite
+(``python/ray/_private/ray_perf.py:93``, run by
+``release/microbenchmark/run_microbenchmark.py``): trivial-task throughput,
+actor-call latency/throughput (sync + pipelined), object put/get bandwidth,
+and a multi-node broadcast — run against BOTH runtimes (the in-process
+``Runtime`` and the multiprocess cluster) so control-plane cost is visible.
+
+Writes one JSON line per metric and aggregates into
+``BENCH_core_r{N}.json`` at the repo root when ``--round N`` is given.
+
+Usage::
+
+    python benches/core_perf.py [--round 3] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+
+
+def timed(fn, *, repeat: int = 1):
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        fn()
+    return (time.perf_counter() - t0) / repeat
+
+
+def bench_tasks(results: dict, n_seq: int, n_par: int) -> None:
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # Warmup: force worker spawns + lease acquisition out of the timing.
+    ray_tpu.get([nop.remote() for _ in range(32)], timeout=300)
+
+    t = timed(lambda: ray_tpu.get(nop.remote(), timeout=60), repeat=n_seq)
+    results["task_seq_latency_us"] = round(t * 1e6, 1)
+    results["task_seq_per_s"] = round(1.0 / t, 1)
+
+    def burst():
+        ray_tpu.get([nop.remote() for _ in range(n_par)], timeout=600)
+
+    burst()  # warm leases for the burst width
+    dt = timed(burst)
+    results["task_throughput_per_s"] = round(n_par / dt, 1)
+
+
+def bench_actors(results: dict, n_seq: int, n_par: int) -> None:
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def incr(self):
+            self.x += 1
+            return self.x
+
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=120)
+
+    t = timed(lambda: ray_tpu.get(c.incr.remote(), timeout=60), repeat=n_seq)
+    results["actor_call_latency_us"] = round(t * 1e6, 1)
+    results["actor_call_per_s"] = round(1.0 / t, 1)
+
+    def pipelined():
+        ray_tpu.get([c.incr.remote() for _ in range(n_par)], timeout=600)
+
+    pipelined()
+    dt = timed(pipelined)
+    results["actor_pipelined_per_s"] = round(n_par / dt, 1)
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def hit(self):
+            return 1
+
+    a = AsyncActor.options(max_concurrency=32).remote()
+    ray_tpu.get(a.hit.remote(), timeout=120)
+
+    def async_burst():
+        ray_tpu.get([a.hit.remote() for _ in range(n_par)], timeout=600)
+
+    async_burst()
+    dt = timed(async_burst)
+    results["async_actor_per_s"] = round(n_par / dt, 1)
+
+
+def bench_objects(results: dict, big_mb: int, n_small: int) -> None:
+    big = np.random.default_rng(0).random(big_mb * 1024 * 1024 // 8)
+
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(big)
+    put_s = time.perf_counter() - t0
+    results["put_gbps"] = round(big.nbytes / put_s / 1e9, 3)
+
+    @ray_tpu.remote
+    def touch(arr):
+        return float(arr[0])  # forces a cross-process fetch of the buffer
+
+    t0 = time.perf_counter()
+    ray_tpu.get(touch.remote(ref), timeout=600)
+    fetch_s = time.perf_counter() - t0
+    results["object_fetch_gbps"] = round(big.nbytes / fetch_s / 1e9, 3)
+    results["object_size_mb"] = big_mb
+    del ref
+
+    payload = b"x" * 1024
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(payload) for _ in range(n_small)]
+    ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    results["small_put_get_per_s"] = round(2 * n_small / dt, 1)
+
+
+def bench_broadcast(results: dict, mb: int, n_nodes: int) -> None:
+    """1-to-N object broadcast across node daemons (the reference's 1 GiB
+    broadcast envelope row, release/benchmarks/README.md:17-19)."""
+    blob = np.ones(mb * 1024 * 1024 // 8)
+    ref = ray_tpu.put(blob)
+
+    @ray_tpu.remote(scheduling_strategy=ray_tpu.SpreadSchedulingStrategy())
+    def consume(arr):
+        return float(arr.sum())
+
+    t0 = time.perf_counter()
+    out = ray_tpu.get([consume.remote(ref) for _ in range(n_nodes)],
+                      timeout=600)
+    dt = time.perf_counter() - t0
+    assert all(abs(v - blob.sum()) < 1e-6 for v in out)
+    results["broadcast_mb"] = mb
+    results["broadcast_nodes"] = n_nodes
+    results["broadcast_gbps"] = round(n_nodes * blob.nbytes / dt / 1e9, 3)
+
+
+def run_suite(runtime: str, quick: bool) -> dict:
+    results: dict = {"runtime": runtime}
+    n_seq = 100 if quick else 300
+    n_par = 500 if quick else 2000
+    big_mb = 64 if quick else 256
+
+    bench_tasks(results, n_seq, n_par)
+    bench_actors(results, n_seq, n_par)
+    bench_objects(results, big_mb, 200 if quick else 1000)
+    if runtime == "multiprocess":
+        bench_broadcast(results, 16 if quick else 64, 4)
+    return results
+
+
+def _settle(core, cluster, timeout: float = 120.0) -> None:
+    """Wait for every daemon's prestarted workers to finish booting —
+    interpreter spawns (~2s of imports each) otherwise steal the box's CPU
+    mid-measurement and the bench reads as contention, not transport."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        stats = [core._daemons.get(h.address).call("stats", timeout=10)
+                 for h in cluster.nodes]
+        if all(s["idle"] >= 2 for s in stats):
+            break
+        time.sleep(1.0)
+    time.sleep(2.0)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--round", type=int, default=0)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--runtime", choices=["local", "multiprocess", "both"],
+                        default="both")
+    args = parser.parse_args()
+
+    all_results = []
+
+    if args.runtime in ("local", "both"):
+        ray_tpu.init(num_nodes=1)
+        r = run_suite("local", args.quick)
+        ray_tpu.shutdown()
+        print(json.dumps(r), flush=True)
+        all_results.append(r)
+
+    if args.runtime in ("multiprocess", "both"):
+        from ray_tpu.core.cluster import Cluster, connect
+
+        cluster = Cluster(num_nodes=4, resources_per_node={"CPU": 2})
+        core = connect(cluster.gcs_address)
+        try:
+            _settle(core, cluster)
+            r = run_suite("multiprocess", args.quick)
+            print(json.dumps(r), flush=True)
+            all_results.append(r)
+        finally:
+            core.shutdown()
+            cluster.shutdown()
+
+    if args.round:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), f"BENCH_core_r{args.round:02d}.json")
+        with open(path, "w") as f:
+            json.dump({"results": all_results}, f, indent=1)
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

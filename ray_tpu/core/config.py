@@ -75,6 +75,9 @@ class Config:
     # Max worker processes per node pool (reference: maximum_startup_concurrency
     # and pool sizing in worker_pool.cc).
     max_workers_per_node = _Flag(8)
+    # Workers spawned into the idle pool at daemon start, capped by the
+    # node's CPU count (reference: worker_pool.cc prestart).
+    prestart_workers_per_node = _Flag(4)
 
     # -- memory monitor / OOM policy (memory_monitor.h:52 analog) -------------
     # Node memory-usage fraction above which the daemon kills the newest

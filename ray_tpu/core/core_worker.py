@@ -208,6 +208,118 @@ class _PendingTask:
         self.cancelled = False  # results arriving after cancel() are dropped
 
 
+class _LeasedWorker:
+    """A GCS resource lease bound to a daemon-granted worker process — the
+    unit of reuse in the direct task transport (the reference's leased-worker
+    entry in ``direct_task_transport.h``)."""
+
+    __slots__ = ("lease_id", "node_id", "node_addr", "worker_id", "worker_addr")
+
+    def __init__(self, lease_id, node_id, node_addr, worker_id, worker_addr):
+        self.lease_id = lease_id
+        self.node_id = node_id
+        self.node_addr = node_addr
+        self.worker_id = worker_id  # bytes
+        self.worker_addr = worker_addr
+
+
+class _QueuedTask:
+    __slots__ = ("spec", "spec_bytes", "pending", "attempt")
+
+    def __init__(self, spec: TaskSpec, pending: _PendingTask):
+        self.spec = spec
+        self.spec_bytes = serialization.dumps(spec)
+        self.pending = pending
+        self.attempt = 0
+
+
+class _KeyState:
+    """Per-scheduling-key submission state (SchedulingKey of
+    ``direct_task_transport.h:54-56``): a FIFO of queued tasks, the set of
+    live runners (one per leased worker), in-flight lease requests, and
+    parked idle leases awaiting reuse or expiry.
+
+    ``waiters`` counts runners blocked on ``cv`` for new work — an idle
+    HOT runner (thread alive, lease held) serves the next task with one
+    cv wake instead of a thread spawn."""
+
+    __slots__ = ("queue", "runners", "requesting", "idle", "cv", "waiters")
+
+    def __init__(self, lock: threading.Lock):
+        from collections import deque
+
+        self.queue = deque()  # _QueuedTask
+        self.runners = 0
+        self.requesting = 0
+        self.waiters = 0
+        self.idle: List[Tuple[_LeasedWorker, float]] = []
+        self.cv = threading.Condition(lock)
+
+
+def _local_host_toward(address: str) -> str:
+    """The local interface IP that routes toward ``address`` — what other
+    machines must dial to reach a server in this process. Loopback clusters
+    stay on loopback."""
+    host = address.rsplit(":", 1)[0]
+    if host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    import socket as _socket
+
+    probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        probe.connect((host, 1))  # no traffic; just picks the route
+        return probe.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        probe.close()
+
+
+def _app_error_should_retry(spec: TaskSpec, attempt: int, result: dict) -> bool:
+    """Shared retry decision for application errors (retry_exceptions
+    option) — ONE definition for both the direct transport and the
+    daemon-proxied runtime_env path."""
+    retry_exc = spec.options.retry_exceptions
+    should = bool(retry_exc) and attempt <= spec.options.max_retries
+    if should and isinstance(retry_exc, (list, tuple)):
+        cause_type = result.get("error_type", "")
+        should = any(t.__name__ == cause_type for t in retry_exc)
+    return should
+
+
+def _retry_delay(attempt: int) -> float:
+    """Backoff before re-leasing after a worker death, so the node's reaper
+    collects the corpse first (retry pacing, task_manager.cc)."""
+    return min(0.2 * attempt, 2.0)
+
+
+class _OwnerService:
+    """RPC facade serving objects this process OWNS from its in-process
+    value cache — the analog of the reference's ownership-based object
+    directory (``ownership_based_object_directory.cc``: small objects live
+    in the owner's memory store and are resolved by asking the owner, not a
+    central service). Every CoreWorker (drivers included) runs one."""
+
+    def __init__(self, core: "CoreWorker"):
+        self._core = core
+
+    def fetch_owned(self, oid_bytes: bytes) -> Optional[bytes]:
+        # Serves ONLY inline-small objects (no sealed replica exists) from
+        # the payload snapshotted at seal time: borrowers see the value as
+        # of put/return, not later mutations, and no re-serialization is
+        # paid per fetch. Large cached values have a shm/daemon replica —
+        # borrowers use the data plane for those.
+        with self._core._cache_lock:
+            return self._core._inline_owned.get(ObjectID(oid_bytes))
+
+    def has_owned(self, oid_bytes: bytes) -> bool:
+        with self._core._cache_lock:
+            return ObjectID(oid_bytes) in self._core._inline_owned
+
+    def ping(self) -> str:
+        return "pong"
+
+
 class CoreWorker:
     """The per-process runtime client (driver or worker mode)."""
 
@@ -268,6 +380,10 @@ class CoreWorker:
         self._cache_lock = threading.Lock()
         self._cache_cv = threading.Condition(self._cache_lock)
         self._pending: Dict[ObjectID, _PendingTask] = {}
+        # Objects this process owns whose ONLY replica is local (inline
+        # returns, small puts, error seals): oid -> payload snapshot taken
+        # at seal time, served by the owner service (_OwnerService).
+        self._inline_owned: Dict[ObjectID, bytes] = {}
 
         # Task submission machinery.
         self._submit_pool = ThreadPoolExecutor(max_workers=128,
@@ -275,6 +391,31 @@ class CoreWorker:
         self._actor_addr_cache: Dict[ActorID, str] = {}
         self._actor_queues: Dict[tuple, dict] = {}
         self._generators: Dict[TaskID, List[ObjectID]] = {}
+        # Direct task transport: per-scheduling-key lease/worker reuse.
+        self._worker_clients = RpcClientPool()
+        self._key_states: Dict[tuple, _KeyState] = {}
+        self._key_lock = threading.Lock()
+        self._lease_sweeper_started = False
+
+        # Batched owner frees (see _free_object).
+        self._free_lock = threading.Lock()
+        self._free_batch: List[bytes] = []
+        self._free_flusher = None
+
+        # Owner service: inline-small objects are served from this process's
+        # cache instead of being sealed through the node daemon (ownership-
+        # based directory; see _OwnerService).
+        from ray_tpu.core.rpc import RpcServer
+
+        # Bind on the interface that routes toward the GCS so owner-served
+        # objects stay reachable on multi-host clusters (loopback clusters
+        # stay loopback).
+        self._owner_server = RpcServer(
+            _OwnerService(self), host=_local_host_toward(gcs_address),
+            name="owner", max_workers=16)
+        self.owner_address = self._owner_server.address
+        self._owner_clients = RpcClientPool()
+        self._owner_down: Dict[str, float] = {}  # addr -> retry-after time
 
         # Execution context (worker mode fills these per task).
         self.current_task_id: Optional[TaskID] = None
@@ -292,7 +433,7 @@ class CoreWorker:
         oid = ObjectID.for_put()
         self._seal_object(oid, value)
         self.reference_counter.set_owned(oid)
-        return ObjectRef(oid)
+        return ObjectRef(oid, owner_hint=self.owner_address)
 
     def _seal_object(self, oid: ObjectID, value, lineage: bytes | None = None) -> None:
         """Store locally + make fetchable cluster-wide."""
@@ -300,6 +441,14 @@ class CoreWorker:
             self._cache[oid] = value
             self._cache_cv.notify_all()
         payload = serialization.dumps(value)
+        if len(payload) <= config().max_inline_object_size:
+            # Small objects stay in the owner's cache and are served by the
+            # owner service — no daemon seal, no GCS location row (the
+            # reference keeps sub-100KiB objects in the owner's in-process
+            # memory store, core_worker.cc:1198).
+            with self._cache_lock:
+                self._inline_owned[oid] = payload
+            return
         if (self._shm is not None
                 and len(payload) >= config().native_store_threshold):
             # Zero-copy plane: write the bytes into the node's shm arena
@@ -321,10 +470,34 @@ class CoreWorker:
                            oid.hex()[:12])
 
     def _free_object(self, oid: ObjectID) -> None:
+        """Owner-side free: drop the local value now, batch the cluster-wide
+        free (one note per ~100 objects / 100 ms instead of one per ref —
+        the reference batches frees the same way in its io_service)."""
         with self._cache_lock:
             self._cache.pop(oid, None)
+            self._inline_owned.pop(oid, None)
+        batch = None
+        with self._free_lock:
+            self._free_batch.append(oid.binary())
+            if self._free_flusher is None:
+                self._free_flusher = threading.Timer(0.1, self._flush_frees)
+                self._free_flusher.daemon = True
+                self._free_flusher.start()
+            elif len(self._free_batch) >= 100:
+                batch, self._free_batch = self._free_batch, []
+        if batch:
+            self._send_frees(batch)  # socket write OUTSIDE the lock
+
+    def _flush_frees(self) -> None:
+        with self._free_lock:
+            batch, self._free_batch = self._free_batch, []
+            self._free_flusher = None
+        if batch:
+            self._send_frees(batch)
+
+    def _send_frees(self, batch) -> None:
         try:
-            self._gcs_rpc.notify("free_object", oid.binary())
+            self._gcs_rpc.notify("free_objects", batch)
         except RpcConnectionError:
             pass
 
@@ -388,7 +561,7 @@ class CoreWorker:
                     # Completed but not cached here (e.g. ref from another
                     # process path) — fall through to the fetch path.
                     pass
-            value = self._try_fetch(oid)
+            value = self._try_fetch(oid, getattr(ref, "_owner_hint", None))
             if value is not _MISSING:
                 with self._cache_cv:
                     self._cache[oid] = value
@@ -437,11 +610,11 @@ class CoreWorker:
         # these; without the increment a recovery could free a dep we own.
         for dep in spec.dependencies():
             self.reference_counter.add_submitted_task_reference(dep)
-        self._submit_pool.submit(self._run_submission, spec, pending)
+        self._submit(spec, pending)
         return True
 
-    def _try_fetch(self, oid: ObjectID):
-        """Local shm → local daemon → remote daemons (pull manager path)."""
+    def _try_fetch(self, oid: ObjectID, owner_hint: str | None = None):
+        """Local shm → owner's in-process store → located daemons."""
         key_bytes = oid.binary()
         if self._shm is not None:
             from ray_tpu.core.node_daemon import NodeDaemon
@@ -453,6 +626,17 @@ class CoreWorker:
                     return serialization.loads(view)
                 finally:
                     self._shm.release(key)
+        if (owner_hint and owner_hint != self.owner_address
+                and not self._owner_unreachable(owner_hint)):
+            # Inline-small objects have no daemon replica and no GCS
+            # location row — their owner serves them directly.
+            try:
+                payload = self._owner_clients.get(owner_hint).call(
+                    "fetch_owned", key_bytes, timeout=30.0)
+                if payload is not None:
+                    return serialization.loads(payload)
+            except (RpcConnectionError, TimeoutError):
+                self._note_owner_unreachable(owner_hint)
         try:
             locations = self._gcs_rpc.call("locate_object", key_bytes)
         except RpcConnectionError:
@@ -468,6 +652,18 @@ class CoreWorker:
                 return serialization.loads(payload)
         return _MISSING
 
+    # Negative cache for owner probes: a dead owner's address must not cost
+    # a blocking connect attempt on every wait()/get() poll.
+    _OWNER_RETRY_S = 5.0
+
+    def _owner_unreachable(self, addr: str) -> bool:
+        until = self._owner_down.get(addr)
+        return until is not None and time.time() < until
+
+    def _note_owner_unreachable(self, addr: str) -> None:
+        self._owner_down[addr] = time.time() + self._OWNER_RETRY_S
+        self._owner_clients.invalidate(addr)
+
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: float | None = None, fetch_local: bool = True):
         refs = list(refs)
@@ -477,7 +673,7 @@ class CoreWorker:
         while True:
             still = []
             for ref in pending:
-                if self._is_ready(ref.id):
+                if self._is_ready(ref):
                     ready.append(ref)
                 else:
                     still.append(ref)
@@ -489,7 +685,8 @@ class CoreWorker:
             time.sleep(0.005)
         return ready, pending
 
-    def _is_ready(self, oid: ObjectID) -> bool:
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id
         with self._cache_lock:
             if oid in self._cache:
                 return True
@@ -501,6 +698,15 @@ class CoreWorker:
 
             if self._shm.contains(NodeDaemon._shm_key(oid.binary())):
                 return True
+        owner_hint = getattr(ref, "_owner_hint", None)
+        if (owner_hint and owner_hint != self.owner_address
+                and not self._owner_unreachable(owner_hint)):
+            try:
+                if self._owner_clients.get(owner_hint).call(
+                        "has_owned", oid.binary(), timeout=10.0):
+                    return True
+            except (RpcConnectionError, TimeoutError):
+                self._note_owner_unreachable(owner_hint)
         try:
             return bool(self._gcs_rpc.call("locate_object", oid.binary()))
         except RpcConnectionError:
@@ -537,7 +743,8 @@ class CoreWorker:
         n = spec.options.num_returns
         num = n if isinstance(n, int) else 0
         return_ids = spec.return_object_ids(num)
-        refs = [ObjectRef(oid) for oid in return_ids]
+        refs = [ObjectRef(oid, owner_hint=self.owner_address)
+                for oid in return_ids]
         for oid in return_ids:
             self.reference_counter.set_owned(oid)
         for dep in spec.dependencies():
@@ -546,8 +753,353 @@ class CoreWorker:
         with self._cache_lock:
             for oid in return_ids:
                 self._pending[oid] = pending
-        self._submit_pool.submit(self._run_submission, spec, pending)
+        self._submit(spec, pending)
         return refs
+
+    def _submit(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        renv = spec.options.runtime_env
+        if renv and renv.get("env_vars"):
+            # runtime_env tasks need a dedicated worker spawned with the env
+            # applied at process start — the daemon owns that; no reuse.
+            self._submit_pool.submit(self._run_submission, spec, pending)
+        else:
+            self._dispatch(_QueuedTask(spec, pending))
+
+    # ---------------- direct task transport ----------------
+
+    @staticmethod
+    def _sched_key(spec: TaskSpec) -> tuple:
+        """Scheduling key (direct_task_transport.h:54-56): resource shape ×
+        strategy. Tasks with equal keys may share leased workers."""
+        from ray_tpu.core import task_spec as ts
+
+        res = tuple(sorted(spec.declared_resources().items()))
+        s = spec.options.scheduling_strategy
+        if s is None or isinstance(s, ts.DefaultSchedulingStrategy):
+            skey: tuple = ("default",)
+        elif isinstance(s, ts.NodeAffinitySchedulingStrategy):
+            skey = ("affinity", s.node_id, s.soft)
+        elif isinstance(s, ts.PlacementGroupSchedulingStrategy):
+            pg = s.placement_group
+            pg_id = getattr(pg, "id", pg)
+            skey = ("pg", pg_id, s.placement_group_bundle_index)
+        elif isinstance(s, ts.SpreadSchedulingStrategy):
+            skey = ("spread",)
+        else:  # NodeLabel and future strategies: keyed but never parked
+            skey = ("other", repr(s))
+        return (res, skey)
+
+    def _dispatch(self, task: _QueuedTask) -> None:
+        """Enqueue + ensure capacity: reuse a parked lease when one exists,
+        otherwise start a lease requester (bounded per key)."""
+        key = self._sched_key(task.spec)
+        with self._key_lock:
+            state = self._key_states.get(key)
+            if state is None:
+                state = self._key_states[key] = _KeyState(self._key_lock)
+            state.queue.append(task)
+            self._ensure_capacity_locked(key, state)
+
+    def _ensure_capacity_locked(self, key: tuple, state: _KeyState) -> None:
+        """Under _key_lock: wake hot runners, hand waiting tasks to parked
+        leases, then start one lease requester per still-unclaimed task
+        (busy runners don't count — each waiting task deserves its own
+        worker; the GCS gates actual grants by resource availability).
+        Runners claim their first task HERE, atomically, so
+        ``len(state.queue)`` is exactly the unclaimed demand and no counter
+        race can strand a task."""
+        if state.waiters:
+            # Hot idle runners (cv-parked with their lease) grab queued
+            # tasks themselves — cheapest handoff, one futex wake.
+            state.cv.notify(min(len(state.queue), state.waiters))
+        covered = state.waiters
+        while state.idle and len(state.queue) > covered:
+            entry, _parked = state.idle.pop()
+            task = state.queue.popleft()
+            state.runners += 1
+            threading.Thread(target=self._runner,
+                             args=(key, state, entry, task),
+                             name="task-runner", daemon=True).start()
+        while state.requesting < min(len(state.queue) - covered, 64):
+            state.requesting += 1
+            spec = state.queue[0].spec
+            threading.Thread(
+                target=self._lease_requester, args=(key, state, spec),
+                name="lease-req", daemon=True).start()
+
+    def _lease_requester(self, key: tuple, state: _KeyState,
+                         spec: TaskSpec) -> None:
+        """Acquire one (GCS lease → daemon worker) pair, then run tasks.
+
+        Every exit transition (give up because demand evaporated, convert
+        into a runner, park a surplus grant) happens atomically under
+        _key_lock with the queue check, so _dispatch can never see a stale
+        ``requesting`` count and strand a queued task."""
+        entry = None
+        first_task = None
+        resources = spec.declared_resources()
+        strategy = spec.options.scheduling_strategy
+        while True:
+            with self._key_lock:
+                if entry is not None:
+                    state.requesting -= 1
+                    if state.queue:
+                        first_task = state.queue.popleft()
+                        state.runners += 1
+                        break
+                    # Demand evaporated between grant and now: park the
+                    # fresh lease (sweeper expires it) or release it.
+                    if self._reusable_key(key) and not self._shutdown:
+                        state.idle.append((entry, time.time()))
+                        self._ensure_sweeper()
+                        return
+                    break  # break with first_task None -> release below
+                if self._shutdown or not state.queue or state.idle:
+                    # Nothing to acquire for (parked leases are handed out
+                    # by _ensure_capacity_locked before requesters spawn).
+                    state.requesting -= 1
+                    self._ensure_capacity_locked(key, state)
+                    return
+            try:
+                granted = self._gcs_rpc.call(
+                    "request_lease", resources, strategy, 5.0, timeout=None)
+            except TimeoutError:
+                continue  # still queued at the GCS; re-check demand
+            except RpcConnectionError as e:
+                self._abort_request(key, state, TaskError(
+                    "lease", f"GCS unreachable: {e}", None))
+                return
+            except Exception as e:  # noqa: BLE001 — infeasible etc.
+                self._abort_request(key, state, TaskError(
+                    "lease", f"lease request failed: {e}", None))
+                return
+            lease_id, node_id, node_addr = granted
+            try:
+                wid, waddr = self._daemons.get(node_addr).call(
+                    "lease_worker", lease_id, timeout=None)
+            except Exception:  # noqa: BLE001 — node died post-grant, or our
+                # own clients are closing (shutdown). The grant must not
+                # leak: release explicitly (no-op if node death already did).
+                try:
+                    self._gcs_rpc.notify("release_lease", lease_id)
+                except RpcConnectionError:
+                    pass
+                time.sleep(0.1)
+                continue
+            entry = _LeasedWorker(lease_id, node_id, node_addr, wid, waddr)
+        if first_task is None:
+            self._release_entry(entry)
+            return
+        self._runner(key, state, entry, first_task)
+
+    def _abort_request(self, key: tuple, state: _KeyState, error) -> None:
+        """Fail everything queued AND decrement ``requesting`` in ONE
+        critical section — a dispatch interleaved between the two would see
+        a stale requesting count, spawn nothing, and strand its task."""
+        with self._key_lock:
+            tasks = list(state.queue)
+            state.queue.clear()
+            state.requesting -= 1
+        for task in tasks:
+            self._finish_task(task, error=error)
+
+    def _runner(self, key: tuple, state: _KeyState, entry: _LeasedWorker,
+                first_task: _QueuedTask) -> None:
+        """Drive one leased worker: pull queued tasks and push them directly
+        (OnWorkerIdle, direct_task_transport.cc:197). Parks the lease when
+        the queue drains; drops it on worker death or lease shed."""
+        alive = self._execute_guarded(entry, first_task)
+        reusable = self._reusable_key(key)
+        while True:
+            with self._key_lock:
+                task = None
+                if alive and not self._shutdown and reusable:
+                    # Spread/label keys never reach here: their placement
+                    # re-runs per task, so each task gets a fresh lease.
+                    if state.queue:
+                        task = state.queue.popleft()
+                    else:
+                        # Hot idle: keep the thread + lease alive up to the
+                        # idle TTL waiting for more work — the next task is
+                        # one cv wake away instead of a thread spawn + lease
+                        # round trip (worker-lease reuse window of
+                        # direct_task_transport.cc).
+                        deadline = time.time() + config().idle_lease_ttl_s
+                        state.waiters += 1
+                        try:
+                            while not state.queue and not self._shutdown:
+                                remaining = deadline - time.time()
+                                if remaining <= 0:
+                                    break
+                                state.cv.wait(remaining)
+                        finally:
+                            state.waiters -= 1
+                        if state.queue and not self._shutdown:
+                            task = state.queue.popleft()
+                if task is None:
+                    state.runners -= 1
+                    release = alive
+                    if not alive:
+                        # Worker/lease gone mid-stream: any still-queued
+                        # tasks need fresh capacity working toward them.
+                        self._ensure_capacity_locked(key, state)
+            if task is None:
+                if release:
+                    self._release_entry(entry)
+                return
+            alive = self._execute_guarded(entry, task)
+
+    @staticmethod
+    def _reusable_key(key: tuple) -> bool:
+        return key[1][0] in ("default", "affinity", "pg")
+
+    def _execute_guarded(self, entry: _LeasedWorker, task: _QueuedTask) -> bool:
+        """_execute_direct with the catch-all _run_submission has: an
+        unexpected exception (unpicklable error blob, broken inline value)
+        must record a TaskError — never kill the runner thread with the
+        pending task unresolved — and must not reuse a worker whose channel
+        state is unknown."""
+        try:
+            return self._execute_direct(entry, task)
+        except BaseException as exc:  # noqa: BLE001
+            logger.exception("direct execution of %s failed",
+                             task.spec.function_name)
+            try:
+                self._finish_task(task, error=TaskError.from_exception(
+                    task.spec.function_name, exc))
+            except BaseException:  # noqa: BLE001 — last resort: unblock get
+                task.pending.done.set()
+            self._kill_entry(entry)
+            return False
+
+    def _kill_entry(self, entry: _LeasedWorker) -> None:
+        """Dispose of a leased worker in UNKNOWN channel state: the daemon
+        kills it (it may be mid-task — it can't rejoin the pool) and the
+        reaper releases its lease."""
+        self._worker_clients.invalidate(entry.worker_addr)
+        try:
+            self._daemons.get(entry.node_addr).notify(
+                "kill_worker", entry.worker_id)
+        except RpcConnectionError:
+            pass
+
+    def _execute_direct(self, entry: _LeasedWorker, task: _QueuedTask) -> bool:
+        """Push one task to the leased worker. Returns False when the entry
+        is no longer usable (worker died / lease shed)."""
+        spec, pending = task.spec, task.pending
+        if pending.cancelled:
+            self._drop_pending(pending)
+            pending.done.set()
+            self._finish_task(task, error=None, record=False)
+            return True
+        task.attempt += 1
+        try:
+            result = self._worker_clients.get(entry.worker_addr).call(
+                "run_task", task.spec_bytes, entry.lease_id, timeout=None)
+        except RpcConnectionError as e:
+            # Worker process died mid-task: daemon's reaper releases the
+            # lease; retry on a fresh lease or surface the death.
+            self._worker_clients.invalidate(entry.worker_addr)
+            if task.attempt <= spec.options.max_retries:
+                logger.info("task %s attempt %d lost its worker (%s); retrying",
+                            spec.function_name, task.attempt, e)
+                self._redispatch_later(task)
+            else:
+                self._finish_task(task, error=TaskError(
+                    spec.function_name, f"WorkerDiedError: {e}", None))
+            return False
+        except Exception as e:  # noqa: BLE001 — transport-level failure
+            # (oversized frame, reply unpickle error...) with the worker
+            # possibly still alive in unknown state: fail the task AND
+            # dispose of the worker+lease so neither leaks.
+            self._finish_task(task, error=TaskError(
+                spec.function_name, f"{type(e).__name__}: {e}", None))
+            self._kill_entry(entry)
+            return False
+        final_lease = result.pop("final_lease_id", entry.lease_id)
+        if result.get("ok"):
+            self._record_task_results(spec, pending, result)
+            self._finish_task(task, error=None, record=False)
+        else:
+            error = serialization.loads(result["error"])
+            if _app_error_should_retry(spec, task.attempt, result):
+                self._redispatch_later(task, delay=0.0)
+            else:
+                self._finish_task(task, error=error)
+        if final_lease is None:
+            # Blocked-release shed the lease and never got it back: the
+            # worker holds no resources — hand it back to the daemon.
+            try:
+                self._daemons.get(entry.node_addr).notify(
+                    "return_leased_worker", entry.worker_id)
+            except RpcConnectionError:
+                pass
+            return False
+        entry.lease_id = final_lease
+        return True
+
+    def _redispatch_later(self, task: _QueuedTask, delay: float = None) -> None:
+        if delay is None:
+            delay = _retry_delay(task.attempt)
+
+        def run():
+            if delay:
+                time.sleep(delay)
+            self._dispatch(task)
+
+        self._submit_pool.submit(run)
+
+    def _drop_pending(self, pending: _PendingTask) -> None:
+        """Remove a finished-by-cancel task's _pending entries (the normal
+        result/error recorders pop them, but a task cancelled before it ever
+        executed reaches neither)."""
+        with self._cache_lock:
+            for oid in pending.refs:
+                self._pending.pop(oid, None)
+
+    def _finish_task(self, task: _QueuedTask, error, record: bool = True) -> None:
+        if record and error is not None:
+            self._record_task_error(task.spec, task.pending, error)
+        for dep in task.spec.dependencies():
+            self.reference_counter.remove_submitted_task_reference(dep)
+
+    def _release_entry(self, entry: _LeasedWorker) -> None:
+        try:
+            self._daemons.get(entry.node_addr).notify(
+                "return_leased_worker", entry.worker_id)
+        except RpcConnectionError:
+            pass
+        try:
+            self._gcs_rpc.notify("release_lease", entry.lease_id)
+        except RpcConnectionError:
+            pass
+
+    def _ensure_sweeper(self) -> None:
+        if self._lease_sweeper_started:
+            return
+        self._lease_sweeper_started = True
+        threading.Thread(target=self._sweep_idle_leases, name="lease-sweeper",
+                         daemon=True).start()
+
+    def _sweep_idle_leases(self) -> None:
+        """Expire parked leases after idle_lease_ttl_s — held resources must
+        not outlive demand (the reference returns workers on lease expiry)."""
+        while not self._shutdown:
+            time.sleep(0.1)
+            ttl = config().idle_lease_ttl_s
+            expired: List[_LeasedWorker] = []
+            now = time.time()
+            with self._key_lock:
+                for state in self._key_states.values():
+                    keep = []
+                    for entry, parked in state.idle:
+                        if now - parked > ttl:
+                            expired.append(entry)
+                        else:
+                            keep.append((entry, parked))
+                    state.idle = keep
+            for entry in expired:
+                self._release_entry(entry)
 
     def _run_submission(self, spec: TaskSpec, pending: _PendingTask) -> None:
         """Lease → push → (maybe retry) → record results. One thread per
@@ -587,6 +1139,7 @@ class CoreWorker:
                 if pending.cancelled:
                     # cancel() already sealed TaskCancelledError; don't lease
                     # or (re-)execute work the user gave up on.
+                    self._drop_pending(pending)
                     pending.done.set()
                     return
                 attempt += 1
@@ -616,7 +1169,7 @@ class CoreWorker:
                                     spec.function_name, attempt, e)
                         # Backoff so the node's reaper collects dead workers
                         # before we lease again (retry pacing, task_manager.cc).
-                        time.sleep(min(0.2 * attempt, 2.0))
+                        time.sleep(_retry_delay(attempt))
                         continue
                     self._record_task_error(
                         spec, pending,
@@ -628,14 +1181,7 @@ class CoreWorker:
                     return
                 # Application error inside the task.
                 error = serialization.loads(result["error"])
-                retry_exc = spec.options.retry_exceptions
-                should_retry = bool(retry_exc) and attempt <= max_retries
-                if should_retry and isinstance(retry_exc, (list, tuple)):
-                    cause_type = result.get("error_type", "")
-                    should_retry = any(
-                        t.__name__ == cause_type for t in retry_exc
-                    )
-                if should_retry:
+                if _app_error_should_retry(spec, attempt, result):
                     continue
                 self._record_task_error(spec, pending, error)
                 return
@@ -657,7 +1203,9 @@ class CoreWorker:
                 return
             for oid_bytes, inline in returns:
                 if inline is not None:
-                    self._cache[ObjectID(oid_bytes)] = serialization.loads(inline)
+                    roid = ObjectID(oid_bytes)
+                    self._cache[roid] = serialization.loads(inline)
+                    self._inline_owned[roid] = bytes(inline)
             for oid in pending.refs:
                 self._pending.pop(oid, None)
             if result.get("generator_items") is not None:
@@ -676,8 +1224,10 @@ class CoreWorker:
                 self._cache_cv.notify_all()
                 pending.done.set()
                 return
+            error_payload = serialization.dumps(error)
             for oid in pending.refs:
                 self._cache[oid] = error
+                self._inline_owned[oid] = error_payload
                 self._pending.pop(oid, None)
             if spec.task_id not in self._generators:
                 # Dynamic-generator task (no pre-declared return ids): the
@@ -701,7 +1251,8 @@ class CoreWorker:
         n = spec.options.num_returns
         num = n if isinstance(n, int) else 0
         return_ids = spec.return_object_ids(num)
-        refs = [ObjectRef(oid) for oid in return_ids]
+        refs = [ObjectRef(oid, owner_hint=self.owner_address)
+                for oid in return_ids]
         for oid in return_ids:
             self.reference_counter.set_owned(oid)
         pending = _PendingTask(return_ids)
@@ -844,9 +1395,14 @@ class CoreWorker:
             if pending is not None and not pending.done.is_set():
                 pending.cancelled = True
                 error = TaskCancelledError(ref.id.task_id())
+                error_payload = serialization.dumps(error)
                 for oid in pending.refs:
                     if oid not in self._cache:
                         self._cache[oid] = error
+                        # Owner-serve the cancellation too: borrowers on
+                        # other processes resolving this ref must observe
+                        # the error, not spin (nothing was ever sealed).
+                        self._inline_owned[oid] = error_payload
                 self._cache_cv.notify_all()
 
     # ====================== generators ======================
@@ -927,14 +1483,38 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        # Wake hot-idle runners and let them hand their leased workers back
+        # while the daemon connections are still open — otherwise the
+        # daemons' conn-close reclaim KILLS those workers (they might be
+        # mid-task) and the pool pays a full respawn.
+        with self._key_lock:
+            for st in self._key_states.values():
+                st.cv.notify_all()
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            with self._key_lock:
+                if not any(st.runners for st in self._key_states.values()):
+                    break
+            time.sleep(0.02)
+        # Hand parked leased workers back before closing the daemon conns.
+        with self._key_lock:
+            parked = [e for st in self._key_states.values()
+                      for e, _t in st.idle]
+            for st in self._key_states.values():
+                st.idle.clear()
+        for entry in parked:
+            self._release_entry(entry)
         if self.mode == "driver":
             try:
                 self._gcs_rpc.notify("finish_job", self.job_id)
             except RpcConnectionError:
                 pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        self._owner_server.stop()
+        self._owner_clients.close_all()
         self._daemons.close_all()
         self._actor_clients.close_all()
+        self._worker_clients.close_all()
         self._gcs_rpc.close()
         if self._shm is not None:
             self._shm.close()

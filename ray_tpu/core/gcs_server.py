@@ -32,7 +32,12 @@ from ray_tpu.core.config import Config, config, set_config
 from ray_tpu.core.gcs import ActorInfo, GlobalControlStore, JobInfo, NodeInfo
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.core.resources import NodeResources, ResourceSet
-from ray_tpu.core.rpc import RpcClientPool, RpcConnectionError, RpcServer
+from ray_tpu.core.rpc import (
+    BoundedSet,
+    RpcClientPool,
+    RpcConnectionError,
+    RpcServer,
+)
 from ray_tpu.core.scheduler import ClusterResourceScheduler
 from ray_tpu.core.task_spec import (
     NodeAffinitySchedulingStrategy,
@@ -44,14 +49,22 @@ logger = get_logger("gcs_server")
 
 
 class _Lease:
-    __slots__ = ("lease_id", "node_id", "resources", "pg_id", "bundle_index")
+    # client_id ties a task lease to the requesting client process (stable
+    # across that client's TCP reconnects) so a client death (kill -9 of a
+    # driver holding reused leases) releases its resources — the reference
+    # gets this from raylet leases dying with the gRPC channel. "" = not
+    # client-scoped (actor leases, snapshot-restored leases).
+    __slots__ = ("lease_id", "node_id", "resources", "pg_id", "bundle_index",
+                 "client_id")
 
-    def __init__(self, lease_id, node_id, resources, pg_id=None, bundle_index=-1):
+    def __init__(self, lease_id, node_id, resources, pg_id=None,
+                 bundle_index=-1, client_id=""):
         self.lease_id = lease_id
         self.node_id = node_id
         self.resources = resources
         self.pg_id = pg_id
         self.bundle_index = bundle_index
+        self.client_id = client_id
 
 
 class _Bundle:
@@ -85,6 +98,11 @@ class GcsService:
         self._node_addr: Dict[NodeID, str] = {}
         self._heartbeats: Dict[NodeID, float] = {}
         self._dead_nodes: set = set()  # explicitly declared dead
+        # Clients whose death cleanup already ran (on_client_closed): late
+        # grants to them are refused instead of leaking. Bounded (uuids
+        # never repeat, so old entries are only a leak) and lifted on
+        # reconnect (a live client must not be banned forever).
+        self._dead_clients = BoundedSet()
         self._leases: Dict[str, _Lease] = {}
         self._next_lease = 0
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
@@ -246,13 +264,18 @@ class GcsService:
     # ====================== leases / scheduling ======================
 
     def request_lease(self, resources: Dict[str, float], strategy=None,
-                      timeout: float = 60.0) -> Tuple[str, NodeID, str]:
+                      timeout: float = 60.0,
+                      _client_id: str = "") -> Tuple[str, NodeID, str]:
         """Blocking lease request: (lease_id, node_id, node_address).
 
         The reference splits this between the driver-side direct task
         transport (``RequestNewWorkerIfNeeded``) and per-raylet
         ``ClusterTaskManager`` queues with spillback; with resource truth
         centralized here, the queue is this condition variable.
+
+        ``_client_id`` (injected by RpcServer from the hello frame) scopes
+        the lease to the calling client process: if that client dies without
+        releasing, the lease is reclaimed in :meth:`on_client_closed`.
         """
         request = ResourceSet(resources)
         deadline = time.time() + timeout
@@ -284,9 +307,17 @@ class GcsService:
                         raise RuntimeError(
                             f"placement group {pg_id} does not exist "
                             "(removed?)")
-                    got = self._try_pg_lease(pg_id, bundle_index, request)
+                if _client_id and _client_id in self._dead_clients:
+                    # Grant-after-death race: the client's cleanup already
+                    # ran while this handler was blocked — granting now
+                    # would leak the lease forever.
+                    raise RuntimeError("client is dead; lease refused")
+                if pg_id is not None:
+                    got = self._try_pg_lease(pg_id, bundle_index, request,
+                                             client_id=_client_id)
                 else:
-                    got = self._try_lease(request, strategy)
+                    got = self._try_lease(request, strategy,
+                                          client_id=_client_id)
                 if got is not None:
                     return got
                 remaining = deadline - time.time()
@@ -297,13 +328,17 @@ class GcsService:
                     )
                 self._sched_cv.wait(timeout=min(remaining, 1.0))
 
-    def _try_lease(self, request: ResourceSet, strategy) -> Optional[Tuple[str, NodeID, str]]:
+    request_lease._rpc_wants_conn = True  # RpcServer injects _client_id
+
+    def _try_lease(self, request: ResourceSet, strategy,
+                   client_id: str = "") -> Optional[Tuple[str, NodeID, str]]:
         node_id = self.scheduler.best_node(request, strategy)
         if node_id is None or not self.scheduler.try_allocate(node_id, request):
             return None
-        return self._grant(node_id, request)
+        return self._grant(node_id, request, client_id=client_id)
 
-    def _try_pg_lease(self, pg_id, bundle_index, request) -> Optional[Tuple[str, NodeID, str]]:
+    def _try_pg_lease(self, pg_id, bundle_index, request,
+                      client_id: str = "") -> Optional[Tuple[str, NodeID, str]]:
         pg = self._pgs.get(pg_id)
         if pg is None or pg.state != "CREATED":
             return None
@@ -313,14 +348,39 @@ class GcsService:
             free = b.resources - b.in_use
             if request.is_subset_of(free) and b.node_id in self._node_addr:
                 b.in_use = b.in_use + request
-                return self._grant(b.node_id, request, pg_id=pg_id, bundle_index=i)
+                return self._grant(b.node_id, request, pg_id=pg_id,
+                                   bundle_index=i, client_id=client_id)
         return None
 
-    def _grant(self, node_id, request, pg_id=None, bundle_index=-1):
+    def _grant(self, node_id, request, pg_id=None, bundle_index=-1,
+               client_id=""):
         self._next_lease += 1
         lease_id = f"lease-{self._next_lease}"
-        self._leases[lease_id] = _Lease(lease_id, node_id, request, pg_id, bundle_index)
+        self._leases[lease_id] = _Lease(lease_id, node_id, request, pg_id,
+                                        bundle_index, client_id=client_id)
         return lease_id, node_id, self._node_addr[node_id]
+
+    def on_client_opened(self, client_id: str) -> None:
+        """A client (re)connected: lift any death ban — a transient >grace
+        network drop must not permanently refuse a live driver."""
+        with self._lock:
+            self._dead_clients.discard(client_id)
+
+    def on_client_closed(self, client_id: str) -> None:
+        """Release leases still scoped to a dead client process (kill -9 of
+        a driver/worker holding reused leases — reference: leases die with
+        the raylet⇄client gRPC channel). Fired by RpcServer after the
+        client's last connection has been gone for the grace period."""
+        if not client_id:
+            return
+        with self._lock:
+            self._dead_clients.add(client_id)
+            orphaned = [l.lease_id for l in self._leases.values()
+                        if l.client_id == client_id]
+            self._sched_cv.notify_all()  # wake its blocked requesters
+        for lease_id in orphaned:
+            logger.info("releasing lease %s after client death", lease_id)
+            self.release_lease(lease_id)
 
     def release_lease(self, lease_id: str) -> None:
         with self._lock:
@@ -643,6 +703,17 @@ class GcsService:
                     self._lineage.pop(next(iter(self._lineage)))
                 self._lineage[tk] = lineage
 
+    def add_lineage(self, object_id: bytes, lineage: bytes) -> None:
+        """Register a task's lineage WITHOUT a location row — inline-small
+        returns have no sealed replica, but their (possibly large) sibling
+        returns still need the creating TaskSpec for reconstruction."""
+        with self._lock:
+            tk = self._task_key(object_id)
+            if tk not in self._lineage:
+                if len(self._lineage) >= self._lineage_cap:
+                    self._lineage.pop(next(iter(self._lineage)))
+                self._lineage[tk] = lineage
+
     def remove_object_location(self, object_id: bytes, node_id: NodeID) -> None:
         with self._lock:
             locs = self._objects.get(object_id)
@@ -684,6 +755,12 @@ class GcsService:
                 self._daemons.get(addr).notify("free_object", object_id)
             except RpcConnectionError:
                 pass
+
+    def free_objects(self, object_ids: List[bytes]) -> None:
+        """Batched owner frees (one note per ~100 refs from the client's
+        free batcher instead of one per dropped ref)."""
+        for oid in object_ids:
+            self.free_object(oid)
 
     # ====================== KV / functions / jobs ======================
 

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu.core.config import Config, config, set_config
 from ray_tpu.core.ids import ActorID, NodeID, WorkerID
 from ray_tpu.core.rpc import (
+    BoundedSet,
     RpcClient,
     RpcClientPool,
     RpcConnectionError,
@@ -139,6 +140,12 @@ class NodeDaemon:
         # Live actor records for GCS-restart re-adoption:
         # actor_id -> (spec_bytes, worker_addr)
         self._actor_records: Dict[ActorID, Tuple[bytes, str]] = {}
+        # Directly-leased workers (the direct task transport): worker_id ->
+        # client_id of the leasing client process, so a client death
+        # reclaims its workers (the reference ties leases to the gRPC
+        # channel; raylet kills leased workers on client disconnect).
+        self._direct_leases: Dict[WorkerID, str] = {}
+        self._dead_clients = BoundedSet()
 
         reply = self._gcs.call(
             "register_node", self.node_id, self.address, resources,
@@ -149,6 +156,15 @@ class NodeDaemon:
         set_config(Config(reply.get("config")))
 
         self._stopped = threading.Event()
+        # Prestart pool workers (worker_pool.cc prestart): interpreter boot
+        # is seconds (jax import), so filling the idle pool at daemon start
+        # keeps first-burst tasks from serializing behind spawns. Read the
+        # ADOPTED cluster config (set_config above), not the boot snapshot.
+        prestart = min(int(num_cpus), config().prestart_workers_per_node)
+        with self._pool_cv:
+            for _ in range(prestart):
+                self._spawn_worker()
+                self._spawn_pending += 1
         threading.Thread(target=self._heartbeat_loop, name="daemon-heartbeat",
                          daemon=True).start()
         threading.Thread(target=self._reaper_loop, name="daemon-reaper",
@@ -459,6 +475,105 @@ class NodeDaemon:
             self._gcs.notify("release_lease", lease_id)
         except RpcConnectionError:
             pass
+
+    # ============== direct task transport (worker leasing) ==============
+
+    def lease_worker(self, lease_id: str,
+                     _client_id: str = "") -> Tuple[bytes, str]:
+        """Grant a pooled worker to the calling client for DIRECT task pushes.
+
+        The client (a core worker holding a GCS resource lease) pushes
+        ``run_task`` straight to the returned worker address — the daemon is
+        out of both the request and reply path, matching the reference's
+        ``direct_task_transport.cc:241 PushNormalTask``. The worker stays
+        bound to the caller until ``return_leased_worker`` or until the
+        caller process dies (then the worker is killed: it may be mid-task,
+        so it can't safely rejoin the pool).
+        """
+        try:
+            worker = self._pop_worker()
+        except BaseException as e:  # noqa: BLE001 — lease must not leak
+            self._release(lease_id)
+            raise WorkerDiedError(f"worker pool exhausted: {e}") from e
+        refused = False
+        with self._pool_lock:
+            if _client_id and _client_id in self._dead_clients:
+                # Grant-after-death race: _pop_worker can block for a spawn
+                # while the client's cleanup runs — handing the worker to a
+                # corpse would strand it busy-forever.
+                self._return_worker_locked_exit(worker)
+                refused = True
+            else:
+                self._worker_lease[worker.worker_id] = lease_id
+                self._direct_leases[worker.worker_id] = _client_id
+        if refused:
+            self._release(lease_id)
+            raise WorkerDiedError("client is dead; worker lease refused")
+        return worker.worker_id.binary(), worker.address
+
+    lease_worker._rpc_wants_conn = True  # RpcServer injects _client_id
+
+    def _return_worker_locked_exit(self, worker: _Worker) -> None:
+        """Return a just-popped worker while already holding _pool_lock."""
+        if (worker.proc.poll() is None and worker.actor_id is None
+                and worker.worker_id in self._workers):
+            worker.busy = False
+            self._idle.append(worker)
+            self._pool_cv.notify_all()
+
+    def kill_worker(self, worker_id_bytes: bytes) -> None:
+        """Client disposes of a directly-leased worker whose channel state
+        is unknown (it may be mid-task): kill it; the reaper releases its
+        lease and collects the process."""
+        worker_id = WorkerID(worker_id_bytes)
+        with self._pool_lock:
+            worker = self._workers.get(worker_id)
+            self._direct_leases.pop(worker_id, None)
+        if worker is not None:
+            try:
+                worker.proc.kill()
+            except OSError:
+                pass
+
+    def return_leased_worker(self, worker_id_bytes: bytes) -> None:
+        """Client is done with a directly-leased worker (lease released by
+        the client at the GCS); worker rejoins the vanilla idle pool."""
+        worker_id = WorkerID(worker_id_bytes)
+        with self._pool_lock:
+            worker = self._workers.get(worker_id)
+            self._worker_lease.pop(worker_id, None)
+            self._direct_leases.pop(worker_id, None)
+        if worker is not None:
+            self._return_worker(worker)
+
+    def on_client_opened(self, client_id: str) -> None:
+        """(Re)connect lifts any death ban (see GcsService.on_client_opened)."""
+        with self._pool_lock:
+            self._dead_clients.discard(client_id)
+
+    def on_client_closed(self, client_id: str) -> None:
+        """Reclaim workers leased by a now-dead client process (fired by
+        RpcServer after the grace period). The worker may be mid-task for
+        the dead client, so kill it — its lease is released by the reaper
+        via ``_worker_lease``."""
+        if not client_id:
+            return
+        with self._pool_lock:
+            self._dead_clients.add(client_id)
+            orphans = [wid for wid, cid in self._direct_leases.items()
+                       if cid == client_id]
+            for wid in orphans:
+                self._direct_leases.pop(wid, None)
+            workers = [self._workers.get(wid) for wid in orphans]
+        for worker in workers:
+            if worker is None:
+                continue
+            logger.info("reclaiming directly-leased worker pid %s after "
+                        "client death", worker.proc.pid)
+            try:
+                worker.proc.kill()
+            except OSError:
+                pass
 
     # ====================== actors ======================
 

@@ -81,7 +81,7 @@ def resolve_options(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Task
 def make_task_args(args, kwargs) -> tuple[list[TaskArg], dict[str, TaskArg]]:
     def convert(v):
         if isinstance(v, ObjectRef):
-            return TaskArg(object_id=v.id)
+            return TaskArg(object_id=v.id, owner_addr=v._owner_hint)
         return TaskArg(value=v)
 
     return [convert(a) for a in args], {k: convert(v) for k, v in kwargs.items()}

@@ -53,6 +53,27 @@ def _auth_token() -> bytes:
 MAX_FRAME = 16 * 1024 * 1024 * 1024
 
 
+class BoundedSet:
+    """Insertion-ordered membership set with an eviction cap — for
+    liveness bookkeeping (dead client ids) that must not grow without
+    bound on a long-lived control plane."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._items: Dict[Any, None] = {}
+
+    def add(self, item) -> None:
+        self._items[item] = None
+        while len(self._items) > self._cap:
+            self._items.pop(next(iter(self._items)))
+
+    def discard(self, item) -> None:
+        self._items.pop(item, None)
+
+    def __contains__(self, item) -> bool:
+        return item in self._items
+
+
 class RpcError(Exception):
     """Base for transport-level failures."""
 
@@ -96,9 +117,15 @@ def _recv_frame(sock: socket.socket) -> Any:
 def _dumps(message: Tuple) -> bytes:
     import cloudpickle
 
+    from ray_tpu.core.serialization import _FastPickler
+
     try:
-        return pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
-    except Exception:
+        import io as _io
+
+        out = _io.BytesIO()
+        _FastPickler(out, protocol=pickle.HIGHEST_PROTOCOL).dump(message)
+        return out.getvalue()
+    except Exception:  # noqa: BLE001 — __main__-defined / unpicklable parts
         return cloudpickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
 
 
@@ -111,6 +138,11 @@ class RpcServer:
     Handlers run on a shared pool so slow calls (task execution, long-poll
     subscriptions) don't block the accept or read loops.
     """
+
+    # Grace period after a client's LAST connection drops before its death
+    # cleanup fires — a transient drop + lazy reconnect must not read as a
+    # client death (the reference's gRPC channels reconnect the same way).
+    CLIENT_DEATH_GRACE_S = 5.0
 
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 64, name: str = "rpc",
@@ -128,6 +160,9 @@ class RpcServer:
         self._stopped = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # Client identity: live-connection counts per client id (the hello
+        # frame), so cleanup keys on CLIENT death, not connection churn.
+        self._client_conns: Dict[str, int] = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{name}-accept", daemon=True
         )
@@ -149,6 +184,7 @@ class RpcServer:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
+        client_id = ""
         try:
             token = self._token
             if token:
@@ -167,11 +203,32 @@ class RpcServer:
                     raise RpcConnectionError("bad auth token")
             while not self._stopped.is_set():
                 kind, req_id, method, data = _recv_frame(conn)
-                if kind == "note":
+                if kind == "hello":
+                    # Client identity frame (sent once right after connect):
+                    # a stable id across this client's reconnects.
+                    if not client_id and isinstance(data, str):
+                        client_id = data
+                        with self._conns_lock:
+                            self._client_conns[client_id] = (
+                                self._client_conns.get(client_id, 0) + 1)
+                        # A reconnect may race (or follow) the death grace
+                        # timer — let the handler lift any ban so a live
+                        # client that dropped >grace seconds isn't refused
+                        # forever.
+                        hook = getattr(self._handler, "on_client_opened",
+                                       None)
+                        if hook is not None:
+                            try:
+                                hook(client_id)
+                            except Exception:  # noqa: BLE001
+                                logger.exception(
+                                    "%s: on_client_opened failed", self._name)
+                elif kind == "note":
                     self._pool.submit(self._run_note, method, data)
                 elif kind == "req":
                     self._pool.submit(
-                        self._run_request, conn, send_lock, req_id, method, data
+                        self._run_request, conn, send_lock, req_id, method,
+                        data, client_id,
                     )
         except (RpcConnectionError, OSError):
             pass
@@ -182,6 +239,36 @@ class RpcServer:
                 conn.close()
             except OSError:
                 pass
+            if client_id:
+                self._on_client_conn_closed(client_id)
+
+    def _on_client_conn_closed(self, client_id: str) -> None:
+        """Client-death detection: when a client's LAST connection closes,
+        wait a grace period (transient drops reconnect lazily), then fire
+        the handler's cleanup — the analog of raylet DisconnectClient on
+        gRPC channel breakage, minus the churn sensitivity."""
+        with self._conns_lock:
+            n = self._client_conns.get(client_id, 1) - 1
+            if n > 0:
+                self._client_conns[client_id] = n
+                return
+            self._client_conns.pop(client_id, None)
+        hook = getattr(self._handler, "on_client_closed", None)
+        if hook is None:
+            return
+
+        def check():
+            with self._conns_lock:
+                if self._client_conns.get(client_id, 0) > 0:
+                    return  # client reconnected within the grace period
+            try:
+                hook(client_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("%s: on_client_closed failed", self._name)
+
+        timer = threading.Timer(self.CLIENT_DEATH_GRACE_S, check)
+        timer.daemon = True
+        timer.start()
 
     def _run_note(self, method: str, data: Tuple) -> None:
         try:
@@ -190,12 +277,15 @@ class RpcServer:
         except Exception:
             logger.exception("%s: notification %s failed", self._name, method)
 
-    def _run_request(self, conn, send_lock, req_id, method, data) -> None:
+    def _run_request(self, conn, send_lock, req_id, method, data,
+                     client_id: str = "") -> None:
         try:
             args, kwargs = data
             fn = getattr(self._handler, method, None)
             if fn is None or method.startswith("_"):
                 raise AttributeError(f"no RPC method '{method}'")
+            if getattr(fn, "_rpc_wants_conn", False):
+                kwargs = dict(kwargs, _client_id=client_id)
             result = fn(*args, **kwargs)
             frame = _dumps(("rep", req_id, method, result))
         except BaseException as exc:  # noqa: BLE001 — propagate to caller
@@ -240,9 +330,14 @@ class RpcClient:
 
     def __init__(self, address: str, connect_timeout: float = 10.0,
                  auth_token: Optional[bytes] = None):
+        import uuid
+
         self.address = address
         self._timeout = connect_timeout
         self._token = _auth_token() if auth_token is None else auth_token
+        # Stable across reconnects: servers key liveness-scoped state
+        # (leases, leased workers) on this, not on TCP connections.
+        self.client_id = uuid.uuid4().hex
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -277,6 +372,12 @@ class RpcClient:
                     raise RpcConnectionError(
                         f"auth handshake to {self.address} failed: {e}"
                     ) from e
+            hello = _dumps(("hello", 0, "", self.client_id))
+            try:
+                sock.sendall(_LEN.pack(len(hello)) + hello)
+            except OSError as e:
+                raise RpcConnectionError(
+                    f"hello to {self.address} failed: {e}") from e
             self._sock = sock
             threading.Thread(
                 target=self._read_loop, args=(sock,),

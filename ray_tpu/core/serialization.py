@@ -86,6 +86,32 @@ def _devicify_for_pickle(obj):
     return obj
 
 
+class _NeedCloudpickle(Exception):
+    pass
+
+
+class _FastPickler(pickle.Pickler):
+    """Plain pickle with a tripwire: anything plain pickle would serialize
+    BY REFERENCE into a module the receiving process may not have
+    (``__main__``-defined classes/functions, interactively defined code)
+    aborts the fast path so cloudpickle serializes it by value. ~5× cheaper
+    than cloudpickle's reducer walk on the control-plane hot path (every
+    TaskSpec crosses this)."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, type) or callable(obj):
+            mod = getattr(obj, "__module__", None)
+            if mod in ("__main__", "__mp_main__", None):
+                raise _NeedCloudpickle
+            registry = cloudpickle.list_registry_pickle_by_value()
+            if registry and any(
+                    mod == r or mod.startswith(r + ".") for r in registry):
+                # register_pickle_by_value(pkg) covers submodules too —
+                # mirror cloudpickle's parent-package walk.
+                raise _NeedCloudpickle
+        return NotImplemented
+
+
 def serialize(obj) -> SerializedObject:
     buffers: list = []
 
@@ -95,9 +121,15 @@ def serialize(obj) -> SerializedObject:
         buffers.append(pickle_buffer.raw())
         return False  # do not serialize in-band
 
-    # Out-of-band numpy: wrap arrays with PickleBuffer-compatible path via
-    # protocol 5. cloudpickle handles closures/lambdas/local classes.
-    header = cloudpickle.dumps(obj, protocol=5, buffer_callback=_buffer_callback)
+    try:
+        out = io.BytesIO()
+        _FastPickler(out, protocol=5,
+                     buffer_callback=_buffer_callback).dump(obj)
+        header = out.getvalue()
+    except Exception:  # noqa: BLE001 — closures/lambdas/__main__ classes
+        buffers.clear()
+        header = cloudpickle.dumps(obj, protocol=5,
+                                   buffer_callback=_buffer_callback)
     return SerializedObject(header=header, buffers=buffers)
 
 

@@ -34,10 +34,16 @@ DAG_LOOP_METHOD = "__ray_tpu_dag_loop__"
 
 @dataclass
 class TaskArg:
-    """Either an inline (already serialized-with-the-spec) value or a ref."""
+    """Either an inline (already serialized-with-the-spec) value or a ref.
+
+    ``owner_addr`` rides with ref args so the executing worker can resolve
+    small objects straight from their owner's in-process store (the
+    reference's ownership-based object directory — ``ObjectReference`` in
+    common.proto:576 carries ``owner_address``)."""
 
     value: Any = None
     object_id: Optional[ObjectID] = None
+    owner_addr: Optional[str] = None
 
     @property
     def is_ref(self) -> bool:

@@ -164,7 +164,10 @@ class WorkerService:
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         def resolve(arg):
             if arg.is_ref:
-                value = self.core._get_one(ObjectRef(arg.object_id), None)
+                value = self.core._get_one(
+                    ObjectRef(arg.object_id,
+                              owner_hint=getattr(arg, "owner_addr", None)),
+                    None)
                 if isinstance(value, (TaskError, TaskCancelledError, ActorError)):
                     raise _DependencyFailed(value)
                 return value
@@ -197,7 +200,10 @@ class WorkerService:
             for i, item in enumerate(result):
                 oid = ObjectID.for_task_return(spec.task_id, i)
                 # Lineage ships once per task (GCS keys it by TaskID prefix).
-                self._seal_return(oid, item, lineage if i == 0 else None)
+                # force_seal: item values don't ride the reply (only their
+                # ids do), so they MUST have a daemon replica.
+                self._seal_return(oid, item, lineage if i == 0 else None,
+                                  force_seal=True)
                 items.append(oid.binary())
             return {"ok": True, "returns": [], "generator_items": items}
         if n == 0:
@@ -213,21 +219,44 @@ class WorkerService:
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
             payload = self._seal_return(oid, value,
-                                        lineage if i == 0 else None)
+                                        lineage if i == 0 else None,
+                                        sealed_siblings=n > 1)
             inline = payload if len(payload) <= inline_cap else None
             returns.append((oid.binary(), inline))
         return {"ok": True, "returns": returns}
 
     def _seal_return(self, oid: ObjectID, value,
-                     lineage: bytes | None = None) -> bytes:
+                     lineage: bytes | None = None,
+                     force_seal: bool = False,
+                     sealed_siblings: bool = False) -> bytes:
         """Seal a return object so any process can fetch it; returns payload.
 
-        Small returns also ride inline in the reply (the reference's
-        ``max_direct_call_object_size`` path, ray_config_def.h:206); they are
-        still sealed node-side so borrowers on other nodes can pull them.
+        Small returns ride inline in the reply into the owner's cache and
+        are served by the owner service from there (the reference's
+        ``max_direct_call_object_size`` path, ray_config_def.h:206 + the
+        owner's in-process memory store) — no daemon seal unless
+        ``force_seal`` (generator items, whose values don't ride a reply).
         """
         payload = serialization.dumps(value)
         core = self.core
+        if (not force_seal
+                and len(payload) <= config().max_inline_object_size):
+            # Inline return: rides the reply into the OWNER's cache and is
+            # served from there (owner service) — no daemon seal, no GCS
+            # location row. Worth ~2 control-plane RPCs per task on the hot
+            # path (the reference's max_direct_call_object_size fast path).
+            # Multi-return tasks: lineage ships with return 0 only, so if
+            # return 0 went inline its large SIBLING returns would lose
+            # their reconstruction record — register lineage alone. (Single
+            # inline returns skip this: their only replica lives with the
+            # owner, and owner death is unrecoverable loss in the reference
+            # too, so the hot path stays at zero control-plane RPCs.)
+            if lineage is not None and sealed_siblings:
+                try:
+                    core._gcs_rpc.notify("add_lineage", oid.binary(), lineage)
+                except RpcConnectionError:
+                    pass
+            return payload
         if (core._shm is not None
                 and len(payload) >= config().native_store_threshold):
             from ray_tpu.core.node_daemon import NodeDaemon

@@ -118,7 +118,10 @@ def test_parallel_execution_across_processes(driver):
         return os.getpid(), t0, time.time()
 
     # Prewarm the worker pools so spawn latency doesn't serialize the run.
-    ray_tpu.get([window.remote(0.01) for _ in range(4)], timeout=120)
+    # 1s windows force 4 CONCURRENT leases (a single warm worker could serve
+    # four trivial tasks back-to-back under lease reuse and leave the other
+    # three workers still spawning).
+    ray_tpu.get([window.remote(1.0) for _ in range(4)], timeout=120)
     # 4s windows: wide enough that submission stagger on a loaded one-core
     # CI box cannot break the all-overlap assertion.
     rs = ray_tpu.get([window.remote(4.0) for _ in range(4)], timeout=120)
