@@ -61,6 +61,14 @@ class Config:
     use_native_store = _Flag(True)
     # Buffers at or above this size go to the native shm arena.
     native_store_threshold = _Flag(64 * 1024)
+    # Node-to-node transfer: objects above pull_chunk_size move as a
+    # pipeline of chunk frames (object_manager.cc:812 chunked transfer)
+    # with at most pull_chunk_concurrency chunks in flight, and total
+    # in-flight pulled bytes capped by pull_memory_budget
+    # (pull_manager.cc:801 memory budgeting).
+    pull_chunk_size = _Flag(8 * 1024 * 1024)
+    pull_chunk_concurrency = _Flag(4)
+    pull_memory_budget = _Flag(512 * 1024 * 1024)
 
     # -- scheduling -----------------------------------------------------------
     # Hybrid policy threshold: below this utilization prefer packing on the

@@ -415,7 +415,10 @@ class CoreWorker:
             name="owner", max_workers=16)
         self.owner_address = self._owner_server.address
         self._owner_clients = RpcClientPool()
-        self._owner_down: Dict[str, float] = {}  # addr -> retry-after time
+        # addr -> (retry_after, first_failure) for owner probes
+        self._owner_down: Dict[str, tuple] = {}
+        self._ready_probe: Dict[ObjectID, float] = {}  # wait() probe throttle
+        self._pull = None  # lazy PullManager (chunked node-to-node fetches)
 
         # Execution context (worker mode fills these per task).
         self.current_task_id: Optional[TaskID] = None
@@ -440,31 +443,78 @@ class CoreWorker:
         with self._cache_cv:
             self._cache[oid] = value
             self._cache_cv.notify_all()
-        payload = serialization.dumps(value)
-        if len(payload) <= config().max_inline_object_size:
+        ser = serialization.serialize(value)
+        size = ser.framed_size()
+        if size <= config().max_inline_object_size:
             # Small objects stay in the owner's cache and are served by the
             # owner service — no daemon seal, no GCS location row (the
             # reference keeps sub-100KiB objects in the owner's in-process
             # memory store, core_worker.cc:1198).
             with self._cache_lock:
-                self._inline_owned[oid] = payload
+                self._inline_owned[oid] = ser.to_bytes()
             return
-        if (self._shm is not None
-                and len(payload) >= config().native_store_threshold):
-            # Zero-copy plane: write the bytes into the node's shm arena
-            # directly (same-node readers map them without a copy), then
-            # register the location.
-            try:
-                from ray_tpu.core.node_daemon import NodeDaemon
+        self.seal_serialized(oid, ser, lineage)
 
-                self._shm.put(NodeDaemon._shm_key(oid.binary()), payload)
+    def seal_serialized(self, oid: ObjectID,
+                        ser: "serialization.SerializedObject",
+                        lineage: bytes | None = None) -> None:
+        """Make a serialized object fetchable cluster-wide, writing the
+        frame DIRECTLY into the local shm arena when possible (no
+        intermediate contiguous copy — fresh-heap materialization of a big
+        payload costs more than the arena write itself)."""
+        from ray_tpu.core.node_daemon import NodeDaemon
+
+        key = NodeDaemon._shm_key(oid.binary())
+        size = ser.framed_size()
+        if self._shm is not None and size >= config().native_store_threshold:
+            view = None
+            try:
+                view = self._shm.create(key, size)
+            except Exception:  # noqa: BLE001 — store closed etc.
+                view = None
+            if view is not None:
+                try:
+                    ser.write_into(view)
+                except BaseException:  # noqa: BLE001 — never leak unsealed
+                    self._shm.abort(key)
+                    raise
+                self._shm.seal(key)
                 self._gcs_rpc.notify("add_object_location", oid.binary(),
-                                     self.current_node_id, len(payload), lineage)
+                                     self.current_node_id, size, lineage)
                 return
-            except Exception:  # noqa: BLE001 — arena full → daemon heap
+        self.seal_payload(oid, ser.to_bytes(), lineage)
+
+    def seal_payload(self, oid: ObjectID, payload, lineage: bytes | None = None) -> None:
+        """Contiguous-payload variant of :meth:`seal_serialized`: shm arena
+        → chunked spill upload for oversized payloads (bounded frames both
+        sides) → daemon heap note for the rest."""
+        from ray_tpu.core.node_daemon import NodeDaemon
+
+        key = NodeDaemon._shm_key(oid.binary())
+        size = len(memoryview(payload).cast("B"))
+        cfg = config()
+        if self._shm is not None and size >= cfg.native_store_threshold:
+            try:
+                self._shm.put(key, payload)
+                self._gcs_rpc.notify("add_object_location", oid.binary(),
+                                     self.current_node_id, size, lineage)
+                return
+            except Exception:  # noqa: BLE001 — arena full
                 pass
+        if size > cfg.pull_chunk_size:
+            # Too big for the arena (or no arena): chunked upload straight
+            # to the daemon's spill shelf — neither side holds a second
+            # whole copy, no object-sized socket frame.
+            from ray_tpu.core.object_transfer import PushManager
+
+            if PushManager(self._daemons).push_spill(
+                    self._node_address, oid.binary(), payload):
+                self._gcs_rpc.notify("add_object_location", oid.binary(),
+                                     self.current_node_id, size, lineage)
+                return
         try:
-            self._local_daemon.notify("put_object", oid.binary(), payload, lineage)
+            self._local_daemon.notify("put_object", oid.binary(), payload,
+                                      lineage)
         except RpcConnectionError:
             logger.warning("local daemon unreachable; object %s is cache-only",
                            oid.hex()[:12])
@@ -583,6 +633,21 @@ class CoreWorker:
                     recovered = True
                     missing_since = None
                     continue
+            owner_hint = getattr(ref, "_owner_hint", None)
+            if (pending is None and owner_hint
+                    and owner_hint != self.owner_address
+                    and self._owner_presumed_dead(owner_hint)):
+                # Object's only possible replica was its owner's in-process
+                # cache (no locations, no lineage — both were just probed)
+                # and the owner has been unreachable past the death window:
+                # fail like the reference's OwnerDiedError instead of
+                # spinning forever.
+                from ray_tpu.core.exceptions import ObjectLostError
+
+                raise ObjectLostError(
+                    oid.hex()[:12],
+                    f"owner process ({owner_hint}) died and no other "
+                    "replica or lineage exists")
             if deadline is not None and time.time() >= deadline:
                 raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
             time.sleep(backoff)
@@ -633,6 +698,7 @@ class CoreWorker:
             try:
                 payload = self._owner_clients.get(owner_hint).call(
                     "fetch_owned", key_bytes, timeout=30.0)
+                self._note_owner_alive(owner_hint)
                 if payload is not None:
                     return serialization.loads(payload)
             except (RpcConnectionError, TimeoutError):
@@ -641,28 +707,101 @@ class CoreWorker:
             locations = self._gcs_rpc.call("locate_object", key_bytes)
         except RpcConnectionError:
             return _MISSING
+        # Prefer a same-node replica (zero extra hop); spread remote pulls
+        # across replicas so broadcasts fan out instead of serializing on
+        # the origin daemon.
+        import random
+
+        locations = list(locations)
+        random.shuffle(locations)
+        locations.sort(key=lambda loc: loc[0] != self.current_node_id)
         for node_id, addr, _size in locations:
             try:
-                payload = self._daemons.get(addr).call(
-                    "fetch_object", key_bytes, timeout=60.0
-                )
+                value = self._fetch_from_daemon(oid, addr)
             except (RpcConnectionError, TimeoutError):
                 continue
-            if payload is not None:
-                return serialization.loads(payload)
+            if value is not _MISSING:
+                return value
         return _MISSING
 
+    def _fetch_from_daemon(self, oid: ObjectID, addr: str):
+        """Fetch one replica: whole-frame for small objects, chunked pull
+        (pipelined bounded frames, budgeted) for big ones — landing the
+        replica in the LOCAL shm arena when possible so this node becomes a
+        new location (broadcast fan-out, push_manager.cc's role)."""
+        from ray_tpu.core.node_daemon import NodeDaemon
+
+        key_bytes = oid.binary()
+        chunk_size = config().pull_chunk_size
+        meta = self._daemons.get(addr).call("object_meta", key_bytes,
+                                            timeout=60.0)
+        if meta is None:
+            return _MISSING
+        size = meta["size"]
+        if size <= chunk_size:
+            payload = self._daemons.get(addr).call("fetch_object", key_bytes,
+                                                   timeout=60.0)
+            if payload is None:
+                return _MISSING
+            return serialization.loads(payload)
+        from ray_tpu.core.object_transfer import PullManager
+
+        if self._pull is None:
+            self._pull = PullManager(self._daemons)
+        key = NodeDaemon._shm_key(key_bytes)
+        dest_view = None
+        if self._shm is not None:
+            try:
+                dest_view = self._shm.create(key, size)
+            except Exception:  # noqa: BLE001 — arena full / contended
+                dest_view = None
+        if dest_view is not None:
+            if not self._pull.pull_into(addr, key_bytes, size, dest_view):
+                self._shm.abort(key)
+                return _MISSING
+            self._shm.seal(key)
+            # This node now holds a replica: register it so other nodes
+            # (and later local readers) stop hitting the origin.
+            try:
+                self._gcs_rpc.notify("add_object_location", key_bytes,
+                                     self.current_node_id, size, None)
+            except RpcConnectionError:
+                pass
+            view = self._shm.get(key)
+            try:
+                return serialization.loads(view)
+            finally:
+                self._shm.release(key)
+        buf = bytearray(size)
+        if not self._pull.pull_into(addr, key_bytes, size, buf):
+            return _MISSING
+        return serialization.loads(buf)
+
     # Negative cache for owner probes: a dead owner's address must not cost
-    # a blocking connect attempt on every wait()/get() poll.
+    # a blocking connect attempt on every wait()/get() poll. An address that
+    # stays unreachable past _OWNER_DEATH_S is presumed dead — objects whose
+    # ONLY replica was that owner's cache raise instead of spinning
+    # (the reference's OwnerDiedError).
     _OWNER_RETRY_S = 5.0
+    _OWNER_DEATH_S = 20.0
 
     def _owner_unreachable(self, addr: str) -> bool:
-        until = self._owner_down.get(addr)
-        return until is not None and time.time() < until
+        entry = self._owner_down.get(addr)
+        return entry is not None and time.time() < entry[0]
 
     def _note_owner_unreachable(self, addr: str) -> None:
-        self._owner_down[addr] = time.time() + self._OWNER_RETRY_S
+        prev = self._owner_down.get(addr)
+        first = prev[1] if prev else time.time()
+        self._owner_down[addr] = (time.time() + self._OWNER_RETRY_S, first)
         self._owner_clients.invalidate(addr)
+
+    def _note_owner_alive(self, addr: str) -> None:
+        self._owner_down.pop(addr, None)
+
+    def _owner_presumed_dead(self, addr: str) -> bool:
+        entry = self._owner_down.get(addr)
+        return (entry is not None
+                and time.time() - entry[1] > self._OWNER_DEATH_S)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: float | None = None, fetch_local: bool = True):
@@ -698,17 +837,29 @@ class CoreWorker:
 
             if self._shm.contains(NodeDaemon._shm_key(oid.binary())):
                 return True
+        # Remote readiness probes (owner RPC + GCS locate) are throttled per
+        # ref: wait() polls every 5 ms and must not turn each poll into
+        # blocking network round trips.
+        now = time.time()
+        next_probe = self._ready_probe.get(oid, 0.0)
+        if now < next_probe:
+            return False
+        self._ready_probe[oid] = now + 0.1
         owner_hint = getattr(ref, "_owner_hint", None)
         if (owner_hint and owner_hint != self.owner_address
                 and not self._owner_unreachable(owner_hint)):
             try:
                 if self._owner_clients.get(owner_hint).call(
                         "has_owned", oid.binary(), timeout=10.0):
+                    self._ready_probe.pop(oid, None)
                     return True
             except (RpcConnectionError, TimeoutError):
                 self._note_owner_unreachable(owner_hint)
         try:
-            return bool(self._gcs_rpc.call("locate_object", oid.binary()))
+            if bool(self._gcs_rpc.call("locate_object", oid.binary())):
+                self._ready_probe.pop(oid, None)
+                return True
+            return False
         except RpcConnectionError:
             return False
 
@@ -839,6 +990,7 @@ class CoreWorker:
         first_task = None
         resources = spec.declared_resources()
         strategy = spec.options.scheduling_strategy
+        pool_failures = 0
         while True:
             with self._key_lock:
                 if entry is not None:
@@ -877,13 +1029,24 @@ class CoreWorker:
             try:
                 wid, waddr = self._daemons.get(node_addr).call(
                     "lease_worker", lease_id, timeout=None)
-            except Exception:  # noqa: BLE001 — node died post-grant, or our
-                # own clients are closing (shutdown). The grant must not
-                # leak: release explicitly (no-op if node death already did).
+            except Exception as e:  # noqa: BLE001 — node died post-grant,
+                # pool exhausted, or our own clients are closing (shutdown).
+                # The grant must not leak: release explicitly (no-op if node
+                # death already did).
                 try:
                     self._gcs_rpc.notify("release_lease", lease_id)
                 except RpcConnectionError:
                     pass
+                pool_failures += 1
+                if pool_failures >= 4:
+                    # A node that persistently cannot produce workers must
+                    # surface as an error, not an infinite lease loop (the
+                    # proxied path counted WorkerDiedError against
+                    # max_retries the same way).
+                    self._abort_request(key, state, TaskError(
+                        "lease", f"cannot obtain a worker after "
+                        f"{pool_failures} grants: {e}", None))
+                    return
                 time.sleep(0.1)
                 continue
             entry = _LeasedWorker(lease_id, node_id, node_addr, wid, waddr)

@@ -104,11 +104,23 @@ class NodeDaemon:
             self._shm = NativeObjectStore(
                 self.store_name, capacity=cfg.object_store_memory
             )
+            # Background page prefault: fresh shm pages fault in ~10x
+            # slower than rewrites under memory ballooning — pay that once
+            # at boot, off the put path.
+            threading.Thread(target=self._shm.prefault,
+                             name="shm-prefault", daemon=True).start()
         except Exception as e:  # noqa: BLE001 — heap fallback keeps tests green
             logger.warning("native shm store unavailable (%s); heap fallback", e)
             self.store_name = ""
         self._heap: Dict[bytes, bytes] = {}
         self._heap_lock = threading.Lock()
+        # Spill shelf (local_object_manager.cc:110 SpillObjects analog):
+        # objects that don't fit the shm arena land on disk, keyed by the
+        # same 20-byte id; served back chunk-wise on fetch.
+        self._spill_dir = os.path.join(cfg.object_spilling_dir,
+                                       self.node_id.hex()[:12])
+        self._spilled: Dict[bytes, int] = {}  # key -> size
+        self._pending_spills: Dict[bytes, float] = {}  # uncommitted uploads
 
         # --- worker pool ----------------------------------------------------
         self._pool_lock = threading.Lock()
@@ -225,6 +237,7 @@ class NodeDaemon:
         import shutil
 
         shutil.rmtree(self._log_dir, ignore_errors=True)
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
         self._server.stop()
 
     # ====================== worker pool ======================
@@ -372,7 +385,11 @@ class NodeDaemon:
 
     def _reaper_loop(self) -> None:
         """Detect worker deaths (the raylet learns via child SIGCHLD)."""
+        last_spill_sweep = time.time()
         while not self._stopped.wait(0.1):
+            if time.time() - last_spill_sweep > 60.0:
+                last_spill_sweep = time.time()
+                self._sweep_stale_spills()
             dead: List[_Worker] = []
             with self._pool_cv:
                 for worker in list(self._workers.values()):
@@ -645,20 +662,148 @@ class NodeDaemon:
         self._gcs.notify("add_object_location", object_id, self.node_id,
                          len(payload), lineage)
 
-    def _store_local(self, object_id: bytes, payload: bytes) -> None:
-        stored = False
-        if self._shm is not None and len(payload) >= config().native_store_threshold:
+    def _store_local(self, object_id: bytes, payload) -> None:
+        mv = memoryview(payload).cast("B")
+        if self._shm is not None and len(mv) >= config().native_store_threshold:
             try:
-                self._shm.put(self._shm_key(object_id), payload)
-                stored = True
-            except Exception:  # noqa: BLE001 — arena full → heap
-                logger.exception("shm put failed; using heap")
-        if not stored:
+                self._shm.put(self._shm_key(object_id), mv)
+                return
+            except Exception:  # noqa: BLE001 — arena full → spill to disk
+                self._spill(object_id, mv)
+                return
+        if len(mv) >= config().native_store_threshold:
+            # No shm arena at all (heap-fallback node): big payloads still
+            # must not pile up in daemon RAM.
+            self._spill(object_id, mv)
+            return
+        with self._heap_lock:
+            self._heap[object_id] = bytes(mv)
+
+    def _spill(self, object_id: bytes, mv: memoryview) -> None:
+        """Spill an object that doesn't fit the arena to disk
+        (``local_object_manager.cc:110 SpillObjects``); a failed disk write
+        falls back to daemon heap rather than silently losing the object."""
+        path = self._spill_path(object_id)
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(mv)
+        except OSError:
+            logger.exception("spill of %s failed; keeping in heap",
+                             object_id.hex()[:12])
             with self._heap_lock:
-                self._heap[object_id] = bytes(payload)
+                self._heap[object_id] = bytes(mv)
+            return
+        with self._heap_lock:
+            self._spilled[object_id] = len(mv)
+        logger.info("spilled object %s (%d bytes) to %s",
+                    object_id.hex()[:12], len(mv), path)
+
+    def _spill_path(self, object_id: bytes) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def object_meta(self, object_id: bytes) -> Optional[dict]:
+        """Size + residency of a local replica — the chunked-pull handshake
+        (the reference's pull manager asks for object size up front to
+        budget chunk requests, ``pull_manager.cc``)."""
+        if self._shm is not None:
+            view = self._shm.get(self._shm_key(object_id))
+            if view is not None:
+                try:
+                    return {"size": len(view), "where": "shm"}
+                finally:
+                    self._shm.release(self._shm_key(object_id))
+        with self._heap_lock:
+            blob = self._heap.get(object_id)
+            if blob is not None:
+                return {"size": len(blob), "where": "heap"}
+            size = self._spilled.get(object_id)
+            if size is not None:
+                return {"size": size, "where": "spill"}
+        return None
+
+    def fetch_object_chunk(self, object_id: bytes, offset: int,
+                           length: int) -> Optional[bytes]:
+        """One chunk of a replica (``object_manager.cc:812`` chunked
+        transfer): bounded frames instead of one object-sized frame."""
+        if self._shm is not None:
+            key = self._shm_key(object_id)
+            view = self._shm.get(key)
+            if view is not None:
+                try:
+                    return bytes(view[offset:offset + length])
+                finally:
+                    self._shm.release(key)
+        with self._heap_lock:
+            blob = self._heap.get(object_id)
+            if blob is not None:
+                return blob[offset:offset + length]
+            spilled = object_id in self._spilled
+        if spilled:
+            try:
+                with open(self._spill_path(object_id), "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                return None
+        return None
+
+    def begin_spill_put(self, object_id: bytes, size: int) -> bool:
+        """Open a chunked UPLOAD straight to the spill shelf — how clients
+        store an object larger than the shm arena without either side ever
+        holding it whole in memory (create_request_queue.cc's fallback
+        allocation, done chunk-wise over the wire)."""
+        os.makedirs(self._spill_dir, exist_ok=True)
+        with open(self._spill_path(object_id), "wb") as f:
+            f.truncate(size)
+        with self._heap_lock:
+            self._pending_spills[object_id] = time.time()
+        return True
+
+    def spill_put_chunk(self, object_id: bytes, offset: int, data: bytes) -> None:
+        with open(self._spill_path(object_id), "r+b") as f:
+            f.seek(offset)
+            f.write(data)
+
+    def commit_spill_put(self, object_id: bytes, size: int,
+                         lineage: bytes | None = None) -> None:
+        with self._heap_lock:
+            self._pending_spills.pop(object_id, None)
+            self._spilled[object_id] = size
+        # The GCS directory keys by the full ObjectID — the caller
+        # registers the location itself.
+
+    def abort_spill_put(self, object_id: bytes) -> None:
+        """Failed upload: drop the partial file now (uncommitted uploads
+        are also swept after _PENDING_SPILL_TTL_S in the reaper, covering
+        clients that died mid-push)."""
+        with self._heap_lock:
+            self._pending_spills.pop(object_id, None)
+        try:
+            os.remove(self._spill_path(object_id))
+        except OSError:
+            pass
+
+    _PENDING_SPILL_TTL_S = 600.0
+
+    def _sweep_stale_spills(self) -> None:
+        now = time.time()
+        with self._heap_lock:
+            stale = [k for k, t in self._pending_spills.items()
+                     if now - t > self._PENDING_SPILL_TTL_S]
+            for k in stale:
+                self._pending_spills.pop(k, None)
+        for k in stale:
+            logger.warning("dropping stale uncommitted spill upload %s",
+                           k.hex()[:12])
+            try:
+                os.remove(self._spill_path(k))
+            except OSError:
+                pass
 
     def fetch_object(self, object_id: bytes) -> Optional[bytes]:
-        """Serve an object's bytes (node-to-node transfer pull path)."""
+        """Serve an object's bytes whole (small objects; chunked pulls use
+        object_meta + fetch_object_chunk)."""
         if self._shm is not None:
             view = self._shm.get(self._shm_key(object_id))
             if view is not None:
@@ -667,19 +812,35 @@ class NodeDaemon:
                 finally:
                     self._shm.release(self._shm_key(object_id))
         with self._heap_lock:
-            return self._heap.get(object_id)
+            blob = self._heap.get(object_id)
+            if blob is not None:
+                return blob
+            spilled = object_id in self._spilled
+        if spilled:
+            try:
+                with open(self._spill_path(object_id), "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        return None
 
     def has_object(self, object_id: bytes) -> bool:
         if self._shm is not None and self._shm.contains(self._shm_key(object_id)):
             return True
         with self._heap_lock:
-            return object_id in self._heap
+            return object_id in self._heap or object_id in self._spilled
 
     def free_object(self, object_id: bytes) -> None:
         if self._shm is not None:
             self._shm.delete(self._shm_key(object_id))
         with self._heap_lock:
             self._heap.pop(object_id, None)
+            spilled = self._spilled.pop(object_id, None)
+        if spilled is not None:
+            try:
+                os.remove(self._spill_path(object_id))
+            except OSError:
+                pass
 
     @staticmethod
     def _shm_key(object_id: bytes) -> bytes:

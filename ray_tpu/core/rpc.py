@@ -208,21 +208,21 @@ class RpcServer:
                     # a stable id across this client's reconnects.
                     if not client_id and isinstance(data, str):
                         client_id = data
+                        # Increment + ban-lift atomically under _conns_lock,
+                        # ordered against the death-grace timer's re-check
+                        # (see _on_client_conn_closed).
                         with self._conns_lock:
                             self._client_conns[client_id] = (
                                 self._client_conns.get(client_id, 0) + 1)
-                        # A reconnect may race (or follow) the death grace
-                        # timer — let the handler lift any ban so a live
-                        # client that dropped >grace seconds isn't refused
-                        # forever.
-                        hook = getattr(self._handler, "on_client_opened",
-                                       None)
-                        if hook is not None:
-                            try:
-                                hook(client_id)
-                            except Exception:  # noqa: BLE001
-                                logger.exception(
-                                    "%s: on_client_opened failed", self._name)
+                            hook = getattr(self._handler, "on_client_opened",
+                                           None)
+                            if hook is not None:
+                                try:
+                                    hook(client_id)
+                                except Exception:  # noqa: BLE001
+                                    logger.exception(
+                                        "%s: on_client_opened failed",
+                                        self._name)
                 elif kind == "note":
                     self._pool.submit(self._run_note, method, data)
                 elif kind == "req":
@@ -258,13 +258,18 @@ class RpcServer:
             return
 
         def check():
+            # Liveness re-check and the death hook run under ONE hold of
+            # _conns_lock, atomically ordered against the hello path (which
+            # increments + lifts bans under the same lock) — otherwise a
+            # reconnect landing between the check and the hook would be
+            # banned forever.
             with self._conns_lock:
                 if self._client_conns.get(client_id, 0) > 0:
                     return  # client reconnected within the grace period
-            try:
-                hook(client_id)
-            except Exception:  # noqa: BLE001
-                logger.exception("%s: on_client_closed failed", self._name)
+                try:
+                    hook(client_id)
+                except Exception:  # noqa: BLE001
+                    logger.exception("%s: on_client_closed failed", self._name)
 
         timer = threading.Timer(self.CLIENT_DEATH_GRACE_S, check)
         timer.daemon = True

@@ -59,6 +59,25 @@ class SerializedObject:
             out.write(mv)
         return out.getvalue()
 
+    def framed_size(self) -> int:
+        """Exact size of the ``to_bytes`` flattening — lets a producer
+        allocate the destination (e.g. a shm arena slot) up front and
+        ``write_into`` it with no intermediate contiguous copy."""
+        return (12 + len(self.header)
+                + sum(8 + len(memoryview(b).cast("B")) for b in self.buffers))
+
+    def write_into(self, dest) -> None:
+        """Write the ``to_bytes`` layout directly into a writable buffer of
+        ``framed_size()`` bytes (no intermediate contiguous copy)."""
+        off = 0
+        off += fast_copy_into(dest, off, len(self.header).to_bytes(8, "big"))
+        off += fast_copy_into(dest, off, self.header)
+        off += fast_copy_into(dest, off, len(self.buffers).to_bytes(4, "big"))
+        for b in self.buffers:
+            mv = memoryview(b).cast("B")
+            off += fast_copy_into(dest, off, len(mv).to_bytes(8, "big"))
+            off += fast_copy_into(dest, off, mv)
+
     @classmethod
     def from_bytes(cls, blob) -> "SerializedObject":
         mv = memoryview(blob).cast("B")
@@ -76,6 +95,18 @@ class SerializedObject:
             buffers.append(mv[off : off + blen])  # zero-copy views into blob
             off += blen
         return cls(header=header, buffers=buffers)
+
+
+def fast_copy_into(dest, dest_offset: int, src) -> int:
+    """memcpy-speed buffer copy: ``dest[dest_offset:...] = src`` through
+    numpy, because memoryview slice assignment degrades to ~75 MB/s on
+    large (especially cross-process shm) buffers. Returns bytes written.
+    One definition for every bulk copy in the object plane."""
+    src_mv = memoryview(src).cast("B")
+    out = np.frombuffer(memoryview(dest).cast("B"), dtype=np.uint8)
+    out[dest_offset:dest_offset + len(src_mv)] = np.frombuffer(
+        src_mv, dtype=np.uint8)
+    return len(src_mv)
 
 
 def _devicify_for_pickle(obj):

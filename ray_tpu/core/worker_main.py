@@ -215,36 +215,37 @@ class WorkerService:
                 f"returned {len(values)} values"
             )
         returns = []
-        inline_cap = config().max_inline_object_size
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
-            payload = self._seal_return(oid, value,
-                                        lineage if i == 0 else None,
-                                        sealed_siblings=n > 1)
-            inline = payload if len(payload) <= inline_cap else None
+            inline = self._seal_return(oid, value,
+                                       lineage if i == 0 else None,
+                                       sealed_siblings=n > 1)
             returns.append((oid.binary(), inline))
         return {"ok": True, "returns": returns}
 
     def _seal_return(self, oid: ObjectID, value,
                      lineage: bytes | None = None,
                      force_seal: bool = False,
-                     sealed_siblings: bool = False) -> bytes:
-        """Seal a return object so any process can fetch it; returns payload.
+                     sealed_siblings: bool = False) -> Optional[bytes]:
+        """Seal a return object so any process can fetch it; returns the
+        payload bytes ONLY when small enough to ride inline in the reply.
 
-        Small returns ride inline in the reply into the owner's cache and
-        are served by the owner service from there (the reference's
+        Small returns ride inline into the owner's cache and are served by
+        the owner service from there (the reference's
         ``max_direct_call_object_size`` path, ray_config_def.h:206 + the
         owner's in-process memory store) — no daemon seal unless
         ``force_seal`` (generator items, whose values don't ride a reply).
+        Big returns are written directly into the shm arena (no contiguous
+        intermediate copy).
         """
-        payload = serialization.dumps(value)
         core = self.core
+        ser = serialization.serialize(value)
+        size = ser.framed_size()
         if (not force_seal
-                and len(payload) <= config().max_inline_object_size):
-            # Inline return: rides the reply into the OWNER's cache and is
-            # served from there (owner service) — no daemon seal, no GCS
-            # location row. Worth ~2 control-plane RPCs per task on the hot
-            # path (the reference's max_direct_call_object_size fast path).
+                and size <= config().max_inline_object_size):
+            # Inline return: rides the reply into the OWNER's cache — no
+            # daemon seal, no GCS location row; worth ~2 control-plane RPCs
+            # per task on the hot path.
             # Multi-return tasks: lineage ships with return 0 only, so if
             # return 0 went inline its large SIBLING returns would lose
             # their reconstruction record — register lineage alone. (Single
@@ -256,25 +257,9 @@ class WorkerService:
                     core._gcs_rpc.notify("add_lineage", oid.binary(), lineage)
                 except RpcConnectionError:
                     pass
-            return payload
-        if (core._shm is not None
-                and len(payload) >= config().native_store_threshold):
-            from ray_tpu.core.node_daemon import NodeDaemon
-
-            try:
-                core._shm.put(NodeDaemon._shm_key(oid.binary()), payload)
-                core._gcs_rpc.notify("add_object_location", oid.binary(),
-                                     core.current_node_id, len(payload),
-                                     lineage)
-                return payload
-            except Exception:  # noqa: BLE001 — arena full → daemon heap
-                pass
-        try:
-            core._local_daemon.notify("put_object", oid.binary(), payload,
-                                      lineage)
-        except RpcConnectionError:
-            logger.warning("daemon unreachable sealing %s", oid.hex()[:12])
-        return payload
+            return ser.to_bytes()
+        core.seal_serialized(oid, ser, lineage)
+        return None
 
     def _package_error(self, spec: TaskSpec, error) -> dict:
         error_bytes = serialization.dumps(error)

@@ -117,11 +117,16 @@ def test_parallel_execution_across_processes(driver):
         time.sleep(sec)
         return os.getpid(), t0, time.time()
 
-    # Prewarm the worker pools so spawn latency doesn't serialize the run.
-    # 1s windows force 4 CONCURRENT leases (a single warm worker could serve
-    # four trivial tasks back-to-back under lease reuse and leave the other
-    # three workers still spawning).
-    ray_tpu.get([window.remote(1.0) for _ in range(4)], timeout=120)
+    # Prewarm until 4 DISTINCT workers answer one batch: 1s windows force 4
+    # concurrent leases (lease reuse would let fewer warm workers serve
+    # trivial tasks back-to-back), and on a loaded 1-core box interpreter
+    # boots take many seconds, so keep batching until the pool is actually
+    # 4 wide.
+    deadline = time.time() + 120
+    while True:
+        warm = ray_tpu.get([window.remote(1.0) for _ in range(4)], timeout=120)
+        if len({pid for pid, _, _ in warm}) >= 4 or time.time() > deadline:
+            break
     # 4s windows: wide enough that submission stagger on a loaded one-core
     # CI box cannot break the all-overlap assertion.
     rs = ray_tpu.get([window.remote(4.0) for _ in range(4)], timeout=120)
