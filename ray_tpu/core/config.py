@@ -101,6 +101,10 @@ class Config:
     health_check_failure_threshold = _Flag(5)
     # Default task retries (reference: task max_retries default 3).
     default_max_retries = _Flag(3)
+    # Streaming generators: max items a producer may run ahead of the
+    # consumer before blocking (reference:
+    # _generator_backpressure_num_objects).
+    streaming_backpressure_items = _Flag(64)
 
     # -- timeouts -------------------------------------------------------------
     rpc_connect_timeout_s = _Flag(10.0)

@@ -293,6 +293,21 @@ def _retry_delay(attempt: int) -> float:
     return min(0.2 * attempt, 2.0)
 
 
+class _GenState:
+    """Owner-side view of one streaming generator task: items indexed as
+    reported (notes may arrive out of order across pool threads), a done
+    flag + total, and the consumer's progress for producer backpressure."""
+
+    __slots__ = ("items", "total", "cv", "consumed", "lock")
+
+    def __init__(self):
+        self.items: Dict[int, ObjectID] = {}
+        self.total: Optional[int] = None  # set when the task completes
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.consumed = 0
+
+
 class _OwnerService:
     """RPC facade serving objects this process OWNS from its in-process
     value cache — the analog of the reference's ownership-based object
@@ -315,6 +330,36 @@ class _OwnerService:
     def has_owned(self, oid_bytes: bytes) -> bool:
         with self._core._cache_lock:
             return ObjectID(oid_bytes) in self._core._inline_owned
+
+    # -- streaming generator reports (core_worker.cc:3199 analog) ---------
+
+    def report_generator_item(self, task_id_bytes: bytes, index: int,
+                              oid_bytes: bytes,
+                              inline: Optional[bytes] = None) -> None:
+        """A producing worker pushes one generator item AS PRODUCED — the
+        consumer's iterator unblocks before the task finishes. Small item
+        values ride inline into the owner's cache (owner-served); big ones
+        were sealed node-side by the producer."""
+        from ray_tpu.core.ids import TaskID
+
+        core = self._core
+        oid = ObjectID(oid_bytes)
+        if inline is not None:
+            with core._cache_lock:
+                core._cache[oid] = serialization.loads(inline)
+                core._inline_owned[oid] = bytes(inline)
+        state = core._generator_state(TaskID(task_id_bytes))
+        with state.cv:
+            state.items[index] = oid
+            state.cv.notify_all()
+
+    def generator_progress(self, task_id_bytes: bytes) -> int:
+        """Producer backpressure probe: how far the consumer has iterated."""
+        from ray_tpu.core.ids import TaskID
+
+        state = self._core._generator_state(TaskID(task_id_bytes))
+        with state.lock:
+            return state.consumed
 
     def ping(self) -> str:
         return "pong"
@@ -390,7 +435,7 @@ class CoreWorker:
                                                thread_name_prefix="submit")
         self._actor_addr_cache: Dict[ActorID, str] = {}
         self._actor_queues: Dict[tuple, dict] = {}
-        self._generators: Dict[TaskID, List[ObjectID]] = {}
+        self._generators: Dict[TaskID, _GenState] = {}
         # Direct task transport: per-scheduling-key lease/worker reuse.
         self._worker_clients = RpcClientPool()
         self._key_states: Dict[tuple, _KeyState] = {}
@@ -891,6 +936,7 @@ class CoreWorker:
     # ====================== tasks ======================
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        spec.owner_addr = self.owner_address
         n = spec.options.num_returns
         num = n if isinstance(n, int) else 0
         return_ids = spec.return_object_ids(num)
@@ -1371,11 +1417,17 @@ class CoreWorker:
                     self._inline_owned[roid] = bytes(inline)
             for oid in pending.refs:
                 self._pending.pop(oid, None)
-            if result.get("generator_items") is not None:
-                self._generators[spec.task_id] = [
-                    ObjectID(b) for b in result["generator_items"]
-                ]
             self._cache_cv.notify_all()
+        if result.get("generator_items") is not None:
+            # Completion record: merge (streamed reports may already have
+            # filled items) and mark the stream done.
+            ids = [ObjectID(b) for b in result["generator_items"]]
+            state = self._generator_state(spec.task_id)
+            with state.cv:
+                for i, goid in enumerate(ids):
+                    state.items.setdefault(i, goid)
+                state.total = len(ids)
+                state.cv.notify_all()
         pending.done.set()
 
     def _record_task_error(self, spec: TaskSpec, pending: _PendingTask,
@@ -1392,14 +1444,22 @@ class CoreWorker:
                 self._cache[oid] = error
                 self._inline_owned[oid] = error_payload
                 self._pending.pop(oid, None)
-            if spec.task_id not in self._generators:
-                # Dynamic-generator task (no pre-declared return ids): the
-                # error must still surface — publish a one-item stream whose
-                # single ref holds the error, so iteration raises at get()
-                # instead of silently yielding zero items.
-                err_oid = ObjectID.for_task_return(spec.task_id, 0)
-                self._cache[err_oid] = error
-                self._generators[spec.task_id] = [err_oid]
+        if spec.options.num_returns in ("dynamic", "streaming"):
+            # The error must surface through the ITERATOR: append it as the
+            # stream's next item (after whatever was already streamed) and
+            # close the stream — iteration raises at get() on that item
+            # instead of silently ending (or hanging) the stream.
+            state = self._generator_state(spec.task_id)
+            with state.cv:
+                next_index = (max(state.items) + 1) if state.items else 0
+                err_oid = ObjectID.for_task_return(spec.task_id, next_index)
+                with self._cache_lock:
+                    self._cache[err_oid] = error
+                    self._inline_owned[err_oid] = error_payload
+                state.items[next_index] = err_oid
+                state.total = next_index + 1
+                state.cv.notify_all()
+        with self._cache_cv:
             self._cache_cv.notify_all()
         pending.error = error
         pending.done.set()
@@ -1411,6 +1471,7 @@ class CoreWorker:
         return self._gcs_rpc.call("create_actor", spec_bytes)
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        spec.owner_addr = self.owner_address
         n = spec.options.num_returns
         num = n if isinstance(n, int) else 0
         return_ids = spec.return_object_ids(num)
@@ -1570,29 +1631,49 @@ class CoreWorker:
 
     # ====================== generators ======================
 
+    def _generator_state(self, task_id: TaskID) -> _GenState:
+        with self._cache_lock:
+            state = self._generators.get(task_id)
+            if state is None:
+                state = self._generators[task_id] = _GenState()
+            return state
+
+    def _gen_item_or_none(self, state: _GenState, index: int):
+        """Under state.lock: the item ref, None for end-of-stream, or
+        _MISSING while the item hasn't been reported yet."""
+        if index in state.items:
+            state.consumed = max(state.consumed, index + 1)
+            return ObjectRef(state.items[index],
+                             owner_hint=self.owner_address)
+        if state.total is not None and index >= state.total:
+            return None
+        return _MISSING
+
     def next_generator_item(self, task_id: TaskID, index: int):
+        """Blocks until the producer has REPORTED item ``index`` (streamed
+        mid-task, core_worker.cc:3199 analog) or the stream ended."""
+        state = self._generator_state(task_id)
         deadline = time.time() + 300.0
-        while True:
-            with self._cache_lock:
-                items = self._generators.get(task_id)
-            if items is not None:
-                if index >= len(items):
-                    return None
-                return ObjectRef(items[index])
-            if time.time() > deadline:
-                raise GetTimeoutError(f"generator {task_id.hex()[:12]} timed out")
-            time.sleep(0.005)
+        with state.cv:
+            while True:
+                got = self._gen_item_or_none(state, index)
+                if got is not _MISSING:
+                    return got
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"generator {task_id.hex()[:12]} timed out")
+                state.cv.wait(timeout=min(remaining, 1.0))
 
     async def next_generator_item_async(self, task_id: TaskID, index: int):
         import asyncio
 
+        state = self._generator_state(task_id)
         while True:
-            with self._cache_lock:
-                items = self._generators.get(task_id)
-            if items is not None:
-                if index >= len(items):
-                    return None
-                return ObjectRef(items[index])
+            with state.lock:
+                got = self._gen_item_or_none(state, index)
+            if got is not _MISSING:
+                return got
             await asyncio.sleep(0.005)
 
     # ====================== placement groups ======================

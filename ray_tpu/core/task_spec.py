@@ -137,6 +137,11 @@ class TaskSpec:
     concurrency_group: str = ""
     # Retry bookkeeping
     attempt_number: int = 0
+    # Owner-service address of the submitting process (ObjectReference's
+    # owner_address, common.proto:576): executing workers push streaming
+    # generator items here as produced (core_worker.cc:3199
+    # HandleReportGeneratorItemReturns analog). "" = no streaming reports.
+    owner_addr: str = ""
 
     def return_object_ids(self, num: Optional[int] = None) -> List[ObjectID]:
         n = num if num is not None else (

@@ -196,16 +196,7 @@ class WorkerService:
             lineage = None
         n = spec.options.num_returns
         if n in ("dynamic", "streaming"):
-            items: List[bytes] = []
-            for i, item in enumerate(result):
-                oid = ObjectID.for_task_return(spec.task_id, i)
-                # Lineage ships once per task (GCS keys it by TaskID prefix).
-                # force_seal: item values don't ride the reply (only their
-                # ids do), so they MUST have a daemon replica.
-                self._seal_return(oid, item, lineage if i == 0 else None,
-                                  force_seal=True)
-                items.append(oid.binary())
-            return {"ok": True, "returns": [], "generator_items": items}
+            return self._stream_generator(spec, result, lineage)
         if n == 0:
             return {"ok": True, "returns": []}
         values = (result,) if n == 1 else tuple(result)
@@ -222,6 +213,63 @@ class WorkerService:
                                        sealed_siblings=n > 1)
             returns.append((oid.binary(), inline))
         return {"ok": True, "returns": returns}
+
+    def _stream_generator(self, spec: TaskSpec, result, lineage) -> dict:
+        """Drive a generator task INCREMENTALLY: every item is reported to
+        the owner as produced (``core_worker.cc:3199
+        HandleReportGeneratorItemReturns`` analog), so the consumer's
+        iterator unblocks mid-task. Small items ride inline in the report
+        (owner-served); big items are sealed node-side first. The producer
+        backpressures when it runs more than
+        ``streaming_backpressure_items`` ahead of the consumer.
+        """
+        owner = None
+        if spec.owner_addr:
+            try:
+                owner = self.core._owner_clients.get(spec.owner_addr)
+            except Exception:  # noqa: BLE001 — buffered fallback below
+                owner = None
+        window = config().streaming_backpressure_items
+        inline_cap = config().max_inline_object_size
+        items: List[bytes] = []
+        for i, item in enumerate(result):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            ser = serialization.serialize(item)
+            if ser.framed_size() <= inline_cap and owner is not None:
+                # Inline item: the report itself delivers the value into
+                # the owner's cache — no seal at all.
+                inline = ser.to_bytes()
+                if i == 0 and lineage is not None:
+                    try:
+                        self.core._gcs_rpc.notify("add_lineage",
+                                                  oid.binary(), lineage)
+                    except RpcConnectionError:
+                        pass
+            else:
+                inline = None
+                self.core.seal_serialized(oid, ser,
+                                          lineage if i == 0 else None)
+            items.append(oid.binary())
+            if owner is not None:
+                try:
+                    owner.notify("report_generator_item", spec.task_id.binary(),
+                                 i, oid.binary(), inline)
+                    if (i + 1) % window == 0:
+                        # Backpressure probe: block until the consumer is
+                        # within one window of the producer.
+                        while True:
+                            consumed = owner.call(
+                                "generator_progress", spec.task_id.binary(),
+                                timeout=60.0)
+                            if i + 1 - consumed <= window:
+                                break
+                            time.sleep(0.02)
+                except (RpcConnectionError, TimeoutError):
+                    owner = None  # owner gone: keep producing, reply carries ids
+                    if inline is not None:
+                        # The report never landed — seal so the id resolves.
+                        self.core.seal_payload(oid, inline)
+        return {"ok": True, "returns": [], "generator_items": items}
 
     def _seal_return(self, oid: ObjectID, value,
                      lineage: bytes | None = None,

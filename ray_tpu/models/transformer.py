@@ -220,6 +220,52 @@ def _make_attention(config: TransformerConfig, mesh: Optional[Mesh]):
     raise ValueError(f"unknown attn_impl {config.attn_impl!r}")
 
 
+def make_block_fn(
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+):
+    """One transformer block as ``block(h, bp) -> h`` — THE layer body,
+    shared by the scan-over-layers forward and the pipeline-parallel path
+    (same math ⇒ PP losses match the non-PP oracle exactly). Sharding
+    constraints no-op when mesh/rules are None (required inside shard_map,
+    where per-device code cannot carry global sharding annotations)."""
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    attention = _make_attention(c, mesh)
+
+    def cstr(x, logical):
+        if mesh is not None and rules is not None:
+            return constrain(x, mesh, rules, logical)
+        return x
+
+    def block(h, bp):
+        positions = jnp.arange(h.shape[1])
+        bp = jax.tree.map(cast, bp)
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("bld,dhk->blhk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        kk = jnp.einsum("bld,dhk->blhk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        vv = jnp.einsum("bld,dhk->blhk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            q = rope(q, positions)
+            kk = rope(kk, positions)
+        q = cstr(q, ("batch", "seq_act", "heads", "head_dim"))
+        kk = cstr(kk, ("batch", "seq_act", "kv_heads", "head_dim"))
+        vv = cstr(vv, ("batch", "seq_act", "kv_heads", "head_dim"))
+        o = attention(q, kk, vv)
+        o = jnp.einsum("blhk,hkd->bld", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
+        h = cstr(h + o, ("batch", "seq_act", None))
+
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = linear(x, bp["w_up"], bp["b_up"])
+        u = cstr(gelu(u), ("batch", "seq_act", "mlp"))
+        d = linear(u, bp["w_down"], bp["b_down"])
+        h = cstr(h + d, ("batch", "seq_act", None))
+        return h
+
+    return block
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -249,30 +295,10 @@ def forward(
         h = h + cast(params["pos_embed"])[positions]
     h = cstr(h, ("batch", "seq_act", None))
 
-    attention = _make_attention(c, mesh)
+    block_body = make_block_fn(c, mesh, rules)
 
     def block(h, bp):
-        bp = jax.tree.map(cast, bp)
-        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
-        q = jnp.einsum("bld,dhk->blhk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
-        kk = jnp.einsum("bld,dhk->blhk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
-        vv = jnp.einsum("bld,dhk->blhk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
-        if c.pos == "rope":
-            q = rope(q, positions)
-            kk = rope(kk, positions)
-        q = cstr(q, ("batch", "seq_act", "heads", "head_dim"))
-        kk = cstr(kk, ("batch", "seq_act", "kv_heads", "head_dim"))
-        vv = cstr(vv, ("batch", "seq_act", "kv_heads", "head_dim"))
-        o = attention(q, kk, vv)
-        o = jnp.einsum("blhk,hkd->bld", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
-        h = cstr(h + o, ("batch", "seq_act", None))
-
-        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
-        u = linear(x, bp["w_up"], bp["b_up"])
-        u = cstr(gelu(u), ("batch", "seq_act", "mlp"))
-        d = linear(u, bp["w_down"], bp["b_down"])
-        h = cstr(h + d, ("batch", "seq_act", None))
-        return h, None
+        return block_body(h, bp), None
 
     if c.remat:
         if c.remat_policy == "dots":
@@ -313,4 +339,68 @@ def lm_loss(
         -100,
     )
     loss, n = softmax_cross_entropy(logits[:, :-1], labels)
+    return loss
+
+
+def pp_lm_loss(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+    *,
+    mesh: Mesh,
+    rules: ShardingRules,
+    num_microbatches: int,
+):
+    """``lm_loss`` with the block stack run as a GPipe pipeline over the
+    ``pipe`` mesh axis (parallel.pipeline) — the capability the reference
+    only gets by delegating to DeepSpeed (SURVEY §2.4), here differentiable
+    end-to-end inside ONE jitted step. Embedding and LM head run replicated
+    across pipe (identical inputs ⇒ identical math on every stage group);
+    only the blocks hand activations stage-to-stage. Losses match the
+    non-PP ``lm_loss`` exactly (same block body, same reduction)."""
+    from ray_tpu.parallel.pipeline import make_pipeline
+
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    tokens = batch["tokens"]
+    B, L = tokens.shape
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    dp = 1
+    for ax in (rules.batch if isinstance(rules.batch, tuple)
+               else (rules.batch,)):
+        if ax is not None and ax in mesh.shape:
+            dp *= mesh.shape[ax]
+    assert (B // num_microbatches) % dp == 0, (
+        f"microbatch {B // num_microbatches} must divide over the "
+        f"data-parallel degree {dp}")
+
+    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    if c.pos == "learned":
+        h = h + cast(params["pos_embed"])[jnp.arange(L)]
+
+    # Blocks must run WITHOUT global sharding constraints (per-device code
+    # inside shard_map) and with a local attention impl (dense/flash).
+    block = make_block_fn(c, None, None)
+    pipeline = make_pipeline(
+        lambda bp, x: block(x, bp),
+        mesh,
+        num_microbatches=num_microbatches,
+        pipe_axis=rules.layers,
+        batch_axes=rules.batch,
+        remat=c.remat,
+    )
+    mb = B // num_microbatches
+    h = pipeline(params["blocks"], h.reshape(num_microbatches, mb, L, -1))
+    h = h.reshape(B, L, -1)
+
+    h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
+    w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bld,dv->blv", h, cast(w_out),
+                        preferred_element_type=jnp.float32).astype(c.dtype)
+    labels = jnp.where(
+        batch.get("loss_mask", jnp.ones_like(tokens))[:, 1:] > 0,
+        tokens[:, 1:],
+        -100,
+    )
+    loss, _n = softmax_cross_entropy(logits[:, :-1], labels)
     return loss

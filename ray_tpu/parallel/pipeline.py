@@ -1,11 +1,15 @@
-"""Pipeline parallelism — GPipe microbatch schedule over a mesh axis.
+"""Pipeline parallelism — differentiable GPipe microbatch schedule on a mesh axis.
 
 The reference delegates PP to DeepSpeed/Accelerate (SURVEY §2.4) and offers
 only the compiled-DAG primitive (``python/ray/dag/compiled_dag_node.py``) for
 cross-actor pipelining. TPU-native, the pipeline is a mesh axis: every device
-holds one stage's parameters (leading ``layers`` dim sharded on ``pipe``),
-activations hand off to the next stage via ``ppermute`` each tick, and the
-whole schedule is one compiled XLA program — no per-tick host round-trips.
+group holds one stage's layer stack (leading ``layers`` dim sharded on
+``pipe``), activations hand off to the next stage via ``ppermute`` each tick,
+and the whole schedule — forward AND backward — is one compiled XLA program
+with no per-tick host round-trips. Reverse-mode AD flows through the
+schedule: the tick loop is a ``lax.scan`` (checkpointable, transposable) and
+``ppermute``'s transpose is the reversed permutation, which IS the backward
+pipeline.
 
 Schedule: classic GPipe fill-drain. For M microbatches on S stages the loop
 runs M + S - 1 ticks; at tick t stage 0 ingests microbatch t (if any) and
@@ -23,62 +27,68 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_pipeline(
-    stage_fn: Callable,
+    layer_fn: Callable,
     mesh: Mesh,
     *,
     num_microbatches: int,
     pipe_axis: str = "pipe",
     batch_axes=("data", "fsdp"),
+    remat: bool = False,
 ):
-    """Build a jittable pipelined forward pass.
+    """Build a jittable, DIFFERENTIABLE pipelined forward pass.
 
-    ``stage_fn(stage_params, x) -> y`` is the per-stage computation; activations
-    must have the same shape as inputs (transformer blocks qualify).
+    ``layer_fn(layer_params, x) -> y`` is the per-LAYER computation;
+    activations must keep the input shape (transformer blocks qualify).
 
     Arguments to the returned function:
-    - ``stage_params``: pytree whose leaves have leading dim = n_stages,
-      sharded on ``pipe_axis``.
+    - ``layer_params``: pytree whose leaves have leading dim = total layers
+      L (sharded on ``pipe_axis``; L must divide evenly into the stage
+      count). Each stage scans its local L/S layers per tick.
     - ``x``: [num_microbatches, microbatch, ...] input, replicated over pipe.
 
-    Returns [num_microbatches, microbatch, ...] outputs (replicated over pipe).
+    Returns [num_microbatches, microbatch, ...] outputs (replicated over
+    pipe). ``jax.grad`` through the result differentiates the whole
+    schedule.
     """
     n_stages = mesh.shape[pipe_axis]
     ticks = num_microbatches + n_stages - 1
+    fn = jax.checkpoint(layer_fn) if remat else layer_fn
 
-    def body(stage_params, x):
-        # Local leaves have leading dim 1 (our stage); drop it.
-        params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    def body(layer_params, x):
         stage = lax.axis_index(pipe_axis)
         is_first = stage == 0
         is_last = stage == n_stages - 1
-        mb_shape = x.shape[1:]
+
+        def apply_stage(inp):
+            def one(h, lp):
+                return fn(lp, h), None
+
+            h, _ = lax.scan(one, inp, layer_params)
+            return h
 
         out0 = jnp.zeros_like(x)
-        carry0 = jnp.zeros(mb_shape, x.dtype)  # activation arriving this tick
+        carry0 = jnp.zeros(x.shape[1:], x.dtype)
 
-        def tick(t, state):
+        def tick(state, t):
             carry, out = state
             mb_index = jnp.clip(t, 0, num_microbatches - 1)
-            fresh = lax.dynamic_index_in_dim(x, mb_index, axis=0, keepdims=False)
+            fresh = lax.dynamic_index_in_dim(x, mb_index, axis=0,
+                                             keepdims=False)
             inp = jnp.where(is_first, fresh, carry)
-            y = stage_fn(params, inp)
-            # Only ticks where this stage holds live data matter; dead ticks
-            # compute garbage that is never written out (fill/drain bubbles).
+            y = apply_stage(inp)
+            # Only ticks where the LAST stage holds live data write output;
+            # fill/drain bubbles compute garbage that is never read (and
+            # therefore receives zero cotangent on the backward pass).
             done_index = t - (n_stages - 1)
             write = jnp.logical_and(is_last, done_index >= 0)
-            out = lax.cond(
-                write,
-                lambda o: lax.dynamic_update_index_in_dim(
-                    o, y, jnp.clip(done_index, 0, num_microbatches - 1), axis=0
-                ),
-                lambda o: o,
-                out,
-            )
+            written = lax.dynamic_update_index_in_dim(
+                out, y, jnp.clip(done_index, 0, num_microbatches - 1), axis=0)
+            out = jnp.where(write, written, out)
             perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
             carry_next = lax.ppermute(y, pipe_axis, perm)
-            return carry_next, out
+            return (carry_next, out), None
 
-        _, out = lax.fori_loop(0, ticks, tick, (carry0, out0))
+        (_, out), _ = lax.scan(tick, (carry0, out0), jnp.arange(ticks))
         # Output lives on the last stage only; psum replicates it (all other
         # stages contribute zeros).
         return lax.psum(out, pipe_axis)

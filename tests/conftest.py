@@ -20,14 +20,25 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Disable the axon TPU plugin preload outright for the whole test tree
+# (drivers AND spawned cluster processes inherit this): tests never touch
+# the real chip, the preload costs ~2s per spawned interpreter, and a
+# wedged TPU tunnel must not be able to hang CPU-only tests at jax init.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
-# The axon TPU plugin force-registers itself as default platform regardless of
-# JAX_PLATFORMS; pin all test computation to the virtual CPU devices and full
-# matmul precision so numerical oracles are exact.
+# The axon TPU plugin registers itself at INTERPRETER start (sitecustomize)
+# and force-overrides the platform list — the JAX_PLATFORMS env var set
+# above is too late to stop it. Re-pin the CONFIG to cpu-only before the
+# first backends() call: tests never touch the real chip, and a wedged TPU
+# tunnel must not be able to hang CPU-only tests at jax init.
+jax.config.update("jax_platforms", "cpu")
+
+# Pin all test computation to the virtual CPU devices and full matmul
+# precision so numerical oracles are exact.
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 jax.config.update("jax_default_matmul_precision", "highest")
 
