@@ -65,6 +65,9 @@ class ActorHandle:
         # are scoped to (caller, handle), mirroring the reference's per-caller
         # submit queues.
         self._caller_id = uuid.uuid4().hex
+        # Option resolution is pure and override-free calls dominate the hot
+        # path — resolve once per handle instead of per call.
+        self._plain_options = resolve_options({"max_retries": 0}, {})
 
     @property
     def actor_id(self) -> ActorID:
@@ -77,7 +80,8 @@ class ActorHandle:
 
     def _submit(self, method_name: str, args, kwargs, overrides):
         rt = get_runtime()
-        options = resolve_options({"max_retries": 0}, overrides)
+        options = (self._plain_options if not overrides
+                   else resolve_options({"max_retries": 0}, overrides))
         task_args, task_kwargs = make_task_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.for_task(rt.job_id, self._actor_id),

@@ -35,7 +35,8 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.gcs import ActorInfo, NodeInfo
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.rpc import RpcClient, RpcClientPool, RpcConnectionError
+from ray_tpu.core.rpc import (RpcClient, RpcClientPool, RpcConnectionError,
+                              RpcRemoteError)
 from ray_tpu.core.task_spec import TaskSpec, TaskType
 from ray_tpu.utils.logging import get_logger
 
@@ -197,6 +198,19 @@ class _LocalRefCounter:
         if free:
             self._core._free_object(object_id)
 
+    def drop_owned_if_unreferenced(self, object_id: ObjectID) -> None:
+        """Free an owned object that never got (or no longer has) any local
+        handle — e.g. generator items the consumer abandoned mid-stream."""
+        free = False
+        with self._lock:
+            if (object_id in self._owned
+                    and not self._local.get(object_id)
+                    and not self._submitted.get(object_id)):
+                self._owned.discard(object_id)
+                free = True
+        if free:
+            self._core._free_object(object_id)
+
 
 class _PendingTask:
     __slots__ = ("refs", "done", "error", "cancelled")
@@ -206,6 +220,25 @@ class _PendingTask:
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
         self.cancelled = False  # results arriving after cancel() are dropped
+
+
+# Max in-flight calls per (actor, handle): bounds client memory for un-acked
+# resend copies while keeping the pipe full (the reference's actor submit
+# queues are unbounded in flight; a window keeps restart resends cheap).
+_ACTOR_WINDOW = 64
+
+
+class _ActorCall:
+    """One submitted actor call held until its reply is acked (the resend
+    unit of the pipelined actor transport)."""
+
+    __slots__ = ("spec", "pending", "spec_bytes", "pinned")
+
+    def __init__(self, spec: TaskSpec, pending: _PendingTask):
+        self.spec = spec
+        self.pending = pending
+        self.spec_bytes: Optional[bytes] = None  # serialized lazily, reused
+        self.pinned = True  # argument refs pinned until terminal
 
 
 class _LeasedWorker:
@@ -298,7 +331,8 @@ class _GenState:
     reported (notes may arrive out of order across pool threads), a done
     flag + total, and the consumer's progress for producer backpressure."""
 
-    __slots__ = ("items", "total", "cv", "consumed", "lock")
+    __slots__ = ("items", "total", "cv", "consumed", "lock", "error_at",
+                 "released", "released_at")
 
     def __init__(self):
         self.items: Dict[int, ObjectID] = {}
@@ -306,6 +340,22 @@ class _GenState:
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.consumed = 0
+        # Index where a task error was sealed into the stream. Item reports
+        # racing the error reply (different connections, no ordering) must
+        # neither overwrite it nor extend the stream past it.
+        self.error_at: Optional[int] = None
+        # Consumer dropped its generator handle: late producer reports are
+        # discarded instead of resurrecting the stream (which nothing would
+        # ever reclaim again).
+        self.released = False
+        self.released_at = 0.0
+
+    def contiguous_len(self) -> int:
+        """Length of the gap-free item prefix. Caller holds ``lock``."""
+        n = 0
+        while n in self.items:
+            n += 1
+        return n
 
 
 class _OwnerService:
@@ -344,13 +394,31 @@ class _OwnerService:
 
         core = self._core
         oid = ObjectID(oid_bytes)
-        if inline is not None:
-            with core._cache_lock:
-                core._cache[oid] = serialization.loads(inline)
-                core._inline_owned[oid] = bytes(inline)
         state = core._generator_state(TaskID(task_id_bytes))
         with state.cv:
-            state.items[index] = oid
+            if state.released or (state.error_at is not None
+                                  and index >= state.error_at):
+                # Stream already terminated (error sealed / consumer dropped
+                # the handle): drop the report BEFORE caching its payload —
+                # an entry cached here would be unreachable by both
+                # release_generator (not in state.items) and refcounting
+                # (never owned), leaking in the owner forever.
+                return
+            # Cache the payload even when the index is already present: the
+            # completion reply (a DIFFERENT connection) can merge this
+            # item's id into state.items before this report lands, and the
+            # inline payload exists nowhere else. setdefault (not
+            # assignment) protects already-present entries in the map.
+            if inline is not None:
+                with core._cache_lock:
+                    core._cache[oid] = serialization.loads(inline)
+                    core._inline_owned[oid] = bytes(inline)
+                # Register inline items with the owner's reference counter
+                # so consumed-and-dropped items are freed instead of
+                # accumulating for the owner's lifetime (unconsumed ones
+                # are collected by release_generator).
+                core.reference_counter.set_owned(oid)
+            state.items.setdefault(index, oid)
             state.cv.notify_all()
 
     def generator_progress(self, task_id_bytes: bytes) -> int:
@@ -463,6 +531,7 @@ class CoreWorker:
         # addr -> (retry_after, first_failure) for owner probes
         self._owner_down: Dict[str, tuple] = {}
         self._ready_probe: Dict[ObjectID, float] = {}  # wait() probe throttle
+        self._ready_probe_sweep = 0.0  # next allowed eviction sweep
         self._pull = None  # lazy PullManager (chunked node-to-node fetches)
 
         # Execution context (worker mode fills these per task).
@@ -778,17 +847,16 @@ class CoreWorker:
 
         key_bytes = oid.binary()
         chunk_size = config().pull_chunk_size
-        meta = self._daemons.get(addr).call("object_meta", key_bytes,
-                                            timeout=60.0)
-        if meta is None:
+        # One round trip for the common case: payload comes back directly
+        # when it fits a chunk frame; only oversized replicas pay the
+        # size-then-chunks handshake.
+        reply = self._daemons.get(addr).call("fetch_or_meta", key_bytes,
+                                             chunk_size, timeout=60.0)
+        if reply is None:
             return _MISSING
-        size = meta["size"]
-        if size <= chunk_size:
-            payload = self._daemons.get(addr).call("fetch_object", key_bytes,
-                                                   timeout=60.0)
-            if payload is None:
-                return _MISSING
-            return serialization.loads(payload)
+        if "payload" in reply:
+            return serialization.loads(reply["payload"])
+        size = reply["size"]
         from ray_tpu.core.object_transfer import PullManager
 
         if self._pull is None:
@@ -889,6 +957,15 @@ class CoreWorker:
         next_probe = self._ready_probe.get(oid, 0.0)
         if now < next_probe:
             return False
+        if len(self._ready_probe) > 4096 and now > self._ready_probe_sweep:
+            # Entries are popped only when a ref turns ready; refs that never
+            # materialize (failed/freed/lost) would otherwise leak an entry
+            # apiece for the driver's lifetime. Evict long-expired ones — at
+            # most once per 30s, so a wait() sweep over >4096 live refs
+            # (all recently probed, nothing evictable) isn't O(n) per probe.
+            self._ready_probe_sweep = now + 30.0
+            self._ready_probe = {
+                k: v for k, v in self._ready_probe.items() if v > now - 60.0}
         self._ready_probe[oid] = now + 0.1
         owner_hint = getattr(ref, "_owner_hint", None)
         if (owner_hint and owner_hint != self.owner_address
@@ -1424,10 +1501,11 @@ class CoreWorker:
             ids = [ObjectID(b) for b in result["generator_items"]]
             state = self._generator_state(spec.task_id)
             with state.cv:
-                for i, goid in enumerate(ids):
-                    state.items.setdefault(i, goid)
-                state.total = len(ids)
-                state.cv.notify_all()
+                if not state.released:
+                    for i, goid in enumerate(ids):
+                        state.items.setdefault(i, goid)
+                    state.total = len(ids)
+                    state.cv.notify_all()
         pending.done.set()
 
     def _record_task_error(self, spec: TaskSpec, pending: _PendingTask,
@@ -1451,14 +1529,24 @@ class CoreWorker:
             # instead of silently ending (or hanging) the stream.
             state = self._generator_state(spec.task_id)
             with state.cv:
-                next_index = (max(state.items) + 1) if state.items else 0
-                err_oid = ObjectID.for_task_return(spec.task_id, next_index)
-                with self._cache_lock:
-                    self._cache[err_oid] = error
-                    self._inline_owned[err_oid] = error_payload
-                state.items[next_index] = err_oid
-                state.total = next_index + 1
-                state.cv.notify_all()
+                if not state.released:
+                    # Seal the error after the gap-free prefix, NOT max+1:
+                    # item reports ride a different connection than this
+                    # error reply, so holes below max would leave the
+                    # consumer blocked on a missing index forever instead
+                    # of raising. In-flight reports below the error index
+                    # still land; at/after it they are dropped (see
+                    # report_generator_item).
+                    next_index = state.contiguous_len()
+                    err_oid = ObjectID.for_task_return(spec.task_id,
+                                                       next_index)
+                    with self._cache_lock:
+                        self._cache[err_oid] = error
+                        self._inline_owned[err_oid] = error_payload
+                    state.items[next_index] = err_oid
+                    state.total = next_index + 1
+                    state.error_at = next_index
+                    state.cv.notify_all()
         with self._cache_cv:
             self._cache_cv.notify_all()
         pending.error = error
@@ -1491,45 +1579,45 @@ class CoreWorker:
         return refs
 
     def _enqueue_actor_call(self, spec: TaskSpec, pending: _PendingTask) -> None:
-        """Per-(actor, handle) ordered dispatch.
+        """Per-(actor, handle) PIPELINED ordered dispatch.
 
-        Calls from one handle go out strictly in sequence-number order, one
-        at a time — the client half of the reference's
-        ``sequential_actor_submit_queue.cc`` contract. Serial dispatch also
-        makes restarts safe: a fresh incarnation always hears this handle's
-        oldest outstanding call first (see worker_main._admit_in_order).
+        Calls from one handle go out in sequence-number order but up to
+        ``_ACTOR_WINDOW`` stay in flight concurrently — the client half of
+        the reference's ``direct_actor_task_submitter`` (which pipelines
+        pushes and relies on server-side sequencing,
+        ``sequential_actor_submit_queue.cc``); our server half is
+        ``worker_main._admit_in_order``. Sends happen under the per-key
+        lock so the TCP byte order matches sequence order; completions
+        arrive on the RPC read-loop thread and immediately pump the next
+        queued call — the sequential fast path needs NO thread-pool
+        handoff at all (caller thread sends, read-loop thread records).
+
+        Restart safety: on connection loss every un-acked call goes back to
+        the heap and a recovery job re-resolves the actor's address and
+        re-sends oldest-first, so a fresh incarnation still hears this
+        handle's oldest outstanding call first.
         """
         key = (spec.actor_id, spec.caller_id)
         with self._cache_lock:
-            queue = self._actor_queues.get(key)
-            if queue is None:
-                queue = {"heap": [], "running": False}
-                self._actor_queues[key] = queue
-            import heapq
-
-            heapq.heappush(queue["heap"], (spec.sequence_number, spec, pending))
-            if queue["running"]:
-                return
-            queue["running"] = True
-        self._submit_pool.submit(self._drain_actor_queue, key, queue)
-
-    def _drain_actor_queue(self, key, queue) -> None:
+            st = self._actor_queues.get(key)
+            if st is None:
+                st = {
+                    "heap": [],            # (seq, _ActorCall) not yet sent
+                    "inflight": {},        # seq -> (_ActorCall, addr)
+                    "lock": threading.RLock(),  # reentrant: _fail_all runs
+                    #   reply callbacks synchronously under our own frames
+                    "recovering": False,   # a recovery job owns the queue
+                    "resolving": False,    # an address-resolution job runs
+                    "failed": set(),       # quarantined incarnation addrs
+                    "deadline": None,      # restart-ladder cutoff
+                }
+                self._actor_queues[key] = st
         import heapq
 
-        while True:
-            with self._cache_lock:
-                if not queue["heap"]:
-                    queue["running"] = False
-                    return
-                _seq, spec, pending = heapq.heappop(queue["heap"])
-            try:
-                self._run_actor_submission(spec, pending)
-            except BaseException as exc:  # noqa: BLE001 — keep draining
-                logger.exception("actor submission failed")
-                self._record_task_error(
-                    spec, pending,
-                    TaskError.from_exception(
-                        f"{spec.function_name}.{spec.actor_method}", exc))
+        with st["lock"]:
+            heapq.heappush(st["heap"],
+                           (spec.sequence_number, _ActorCall(spec, pending)))
+            self._pump_actor_queue(key, st)
 
     def _actor_address(self, actor_id: ActorID, timeout: float = 120.0) -> str:
         addr = self._actor_addr_cache.get(actor_id)
@@ -1541,66 +1629,219 @@ class CoreWorker:
         self._actor_addr_cache[actor_id] = addr
         return addr
 
-    def _run_actor_submission(self, spec: TaskSpec, pending: _PendingTask) -> None:
-        """Direct actor transport with restart-transparent redirection.
+    def _pump_actor_queue(self, key, st) -> None:
+        """Send queued calls while the window has room. Caller holds
+        ``st['lock']``."""
+        import heapq
 
-        On connection loss the call is retried against the actor's *next*
-        incarnation: the failed address is quarantined and we poll the GCS
-        actor table until the address changes (the daemon's death report or
-        the GCS health check drives the restart), mirroring the reference's
-        client resubmit-to-new-address path (gcs pubsub of actor state +
-        ``direct_actor_task_submitter``'s pending queue flush on reconnect).
-        Raises ActorDiedError once the restart ladder is exhausted.
-        """
-        spec_bytes = serialization.dumps(spec)
-        failed_addrs: set = set()
-        deadline = time.time() + 300.0
+        if st["recovering"]:
+            return
+        while st["heap"] and len(st["inflight"]) < _ACTOR_WINDOW:
+            addr = self._actor_addr_cache.get(key[0])
+            if addr is None:
+                # Resolution can block on wait_actor_alive — punt to a pool
+                # thread once; it re-pumps when the address is known.
+                if not st["resolving"]:
+                    st["resolving"] = True
+                    try:
+                        self._submit_pool.submit(self._resolve_and_pump,
+                                                 key, st)
+                    except RuntimeError:  # pool shut down
+                        st["resolving"] = False
+                        return
+                return
+            if addr in st["failed"]:
+                # Stale table entry: quarantined incarnation. Recovery owns
+                # the wait-for-new-address loop.
+                self._begin_actor_recovery(key, st, addr)
+                return
+            seq, call = heapq.heappop(st["heap"])
+            if call.spec_bytes is None:
+                # The admission baseline for a fresh incarnation: this
+                # handle's lowest outstanding seq right now (recovery clears
+                # spec_bytes so resends recompute it).
+                call.spec.window_min = min(st["inflight"], default=seq)
+                try:
+                    call.spec_bytes = serialization.dumps(call.spec)
+                except BaseException as exc:  # noqa: BLE001 — unpicklable arg
+                    self._finish_actor_call(call)
+                    self._record_task_error(
+                        call.spec, call.pending,
+                        TaskError.from_exception(
+                            f"{call.spec.function_name}."
+                            f"{call.spec.actor_method}", exc))
+                    continue
+            client = self._actor_clients.get(addr)
+            st["inflight"][seq] = (call, addr)
+            try:
+                fut = client.call_async("run_actor_task", call.spec_bytes)
+            except (RpcConnectionError, OSError):
+                # call_async may have synchronously failed other in-flight
+                # futures (reentrant callbacks already moved them back).
+                if st["inflight"].pop(seq, None):
+                    heapq.heappush(st["heap"], (seq, call))
+                self._begin_actor_recovery(key, st, addr)
+                return
+            fut.add_done_callback(
+                lambda f, seq=seq, addr=addr: self._on_actor_reply(
+                    key, st, seq, addr, f))
+
+    def _resolve_and_pump(self, key, st) -> None:
         try:
-            self._run_actor_submission_loop(spec, pending, spec_bytes,
-                                            failed_addrs, deadline)
-        finally:
-            for dep in spec.dependencies():
-                self.reference_counter.remove_submitted_task_reference(dep)
+            self._actor_address(key[0])
+        except Exception as e:  # noqa: BLE001 — actor dead / timeout
+            with st["lock"]:
+                st["resolving"] = False
+                calls = self._take_all_queued(st)
+            self._fail_actor_calls(
+                calls, ActorDiedError(key[0].hex(), f"actor unavailable: {e}"))
+            return
+        with st["lock"]:
+            st["resolving"] = False
+            self._pump_actor_queue(key, st)
 
-    def _run_actor_submission_loop(self, spec, pending, spec_bytes,
-                                   failed_addrs, deadline) -> None:
+    def _on_actor_reply(self, key, st, seq, addr, fut) -> None:
+        """Completion handler — runs on the RPC read-loop thread (or
+        synchronously under ``_fail_all``)."""
+        import heapq
+
+        try:
+            result = fut.result()
+        except RpcConnectionError:
+            with st["lock"]:
+                ent = st["inflight"].pop(seq, None)
+                if ent is not None:
+                    ent[0].spec_bytes = None  # resend: fresh window_min
+                    heapq.heappush(st["heap"], (seq, ent[0]))
+                self._begin_actor_recovery(key, st, addr)
+            return
+        except RpcRemoteError as e:
+            with st["lock"]:
+                ent = st["inflight"].pop(seq, None)
+            if ent is not None:
+                call = ent[0]
+                self._finish_actor_call(call)
+                self._record_task_error(
+                    call.spec, call.pending,
+                    TaskError.from_exception(
+                        f"{call.spec.function_name}.{call.spec.actor_method}",
+                        e.cause))
+            with st["lock"]:
+                self._pump_actor_queue(key, st)
+            return
+        with st["lock"]:
+            ent = st["inflight"].pop(seq, None)
+        if ent is None:
+            return
+        call = ent[0]
+        try:
+            self._finish_actor_call(call)
+            with st["lock"]:  # racing _begin_actor_recovery's quarantine
+                if not st["recovering"]:
+                    st["failed"].clear()  # incarnation works; reset ladder
+                    st["deadline"] = None
+            if result.get("ok"):
+                self._record_task_results(call.spec, call.pending, result)
+            else:
+                self._record_task_error(call.spec, call.pending,
+                                        serialization.loads(result["error"]))
+        except BaseException as exc:  # noqa: BLE001 — keep the read loop
+            # alive AND seal the pending task (e.g. a reply whose payload
+            # can't be unpickled here) so ray.get raises instead of hanging.
+            logger.exception("actor reply handling failed")
+            try:
+                self._record_task_error(
+                    call.spec, call.pending,
+                    TaskError.from_exception(
+                        f"{call.spec.function_name}.{call.spec.actor_method}",
+                        exc))
+            except BaseException:  # noqa: BLE001
+                logger.exception("sealing reply-handling error failed")
+        with st["lock"]:
+            self._pump_actor_queue(key, st)
+
+    def _begin_actor_recovery(self, key, st, addr) -> None:
+        """Caller holds ``st['lock']``. Quarantine the incarnation, fail
+        every un-acked in-flight call back to the heap, and start ONE
+        recovery job that waits for the next incarnation."""
+        import heapq
+
+        if st["recovering"]:
+            return
+        st["recovering"] = True
+        st["failed"].add(addr)
+        if st["deadline"] is None:
+            st["deadline"] = time.time() + 300.0
+        self._actor_addr_cache.pop(key[0], None)
+        # Closing the client fails remaining in-flight futures; their
+        # callbacks run synchronously HERE (reentrant lock) and each takes
+        # the recovering-early-return path after re-heaping itself below.
+        self._actor_clients.invalidate(addr)
+        for seq, (call, _a) in sorted(st["inflight"].items()):
+            call.spec_bytes = None  # re-serialize with a fresh window_min
+            heapq.heappush(st["heap"], (seq, call))
+        st["inflight"].clear()
+        try:
+            self._submit_pool.submit(self._recover_actor_queue, key, st)
+        except RuntimeError:  # pool shut down (driver exit)
+            st["recovering"] = False
+
+    def _recover_actor_queue(self, key, st) -> None:
+        """Pool thread: wait out the restart ladder, then re-pump (oldest
+        outstanding call first — the heap ordering guarantees it)."""
         while True:
             try:
-                addr = self._actor_address(spec.actor_id)
+                addr = self._actor_address(key[0])
             except Exception as e:  # noqa: BLE001 — actor dead / timeout
-                self._record_task_error(
-                    spec, pending,
-                    ActorDiedError(spec.actor_id.hex(),
-                                   f"actor unavailable: {e}"))
+                with st["lock"]:
+                    st["recovering"] = False
+                    calls = self._take_all_queued(st)
+                self._fail_actor_calls(
+                    calls,
+                    ActorDiedError(key[0].hex(), f"actor unavailable: {e}"))
                 return
-            if addr in failed_addrs:
-                # Stale table entry (the control plane hasn't noticed the
-                # death yet). Wait for the address to change or the actor
-                # to die rather than hammering a corpse.
-                if time.time() > deadline:
-                    self._record_task_error(
-                        spec, pending,
-                        ActorDiedError(spec.actor_id.hex(),
-                                       "actor stuck on a dead worker"))
+            with st["lock"]:
+                if addr not in st["failed"]:
+                    st["recovering"] = False
+                    self._pump_actor_queue(key, st)
                     return
-                self._actor_addr_cache.pop(spec.actor_id, None)
-                time.sleep(0.2)
-                continue
-            try:
-                result = self._actor_clients.get(addr).call(
-                    "run_actor_task", spec_bytes, timeout=None
-                )
-            except RpcConnectionError:
-                failed_addrs.add(addr)
-                self._actor_addr_cache.pop(spec.actor_id, None)
-                self._actor_clients.invalidate(addr)
-                continue
-            if result.get("ok"):
-                self._record_task_results(spec, pending, result)
-            else:
-                self._record_task_error(
-                    spec, pending, serialization.loads(result["error"]))
-            return
+                deadline = st["deadline"]
+                if deadline is not None and time.time() > deadline:
+                    st["recovering"] = False
+                    calls = self._take_all_queued(st)
+                else:
+                    calls = None
+            if calls is not None:
+                self._fail_actor_calls(
+                    calls, ActorDiedError(key[0].hex(),
+                                          "actor stuck on a dead worker"))
+                return
+            # Stale table entry: wait for the control plane to notice the
+            # death rather than hammering a corpse.
+            self._actor_addr_cache.pop(key[0], None)
+            time.sleep(0.2)
+
+    def _take_all_queued(self, st) -> list:
+        """Caller holds ``st['lock']``: drain heap + inflight, oldest
+        first."""
+        calls = [c for _seq, c in sorted(st["heap"])]
+        st["heap"].clear()
+        for _seq, (call, _a) in sorted(st["inflight"].items()):
+            calls.append(call)
+        st["inflight"].clear()
+        return calls
+
+    def _fail_actor_calls(self, calls, error) -> None:
+        for call in calls:
+            self._finish_actor_call(call)
+            self._record_task_error(call.spec, call.pending, error)
+
+    def _finish_actor_call(self, call) -> None:
+        """Drop the submission-duration argument pins exactly once."""
+        if call.pinned:
+            call.pinned = False
+            for dep in call.spec.dependencies():
+                self.reference_counter.remove_submitted_task_reference(dep)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._actor_addr_cache.pop(actor_id, None)
@@ -1675,6 +1916,42 @@ class CoreWorker:
             if got is not _MISSING:
                 return got
             await asyncio.sleep(0.005)
+
+    def release_generator(self, task_id: TaskID) -> None:
+        """Consumer dropped its ObjectRefGenerator: reclaim the stream
+        state and free owned items the consumer never took a ref to
+        (items < consumed are governed by their handed-out ObjectRefs).
+
+        The state stays in the table as a released tombstone so a
+        still-producing worker's late reports are discarded rather than
+        resurrecting an unreclaimable stream; tombstones are trimmed once
+        the table grows past a bound."""
+        with self._cache_lock:
+            state = self._generators.get(task_id)
+        if state is None:
+            return
+        with state.lock:
+            if state.released:
+                return
+            state.released = True
+            state.released_at = time.time()
+            orphans = [oid for idx, oid in state.items.items()
+                       if idx >= state.consumed]
+            state.items.clear()
+        for oid in orphans:
+            self.reference_counter.drop_owned_if_unreferenced(oid)
+        with self._cache_lock:
+            if len(self._generators) > 4096:
+                # Trim only tombstones whose producer can no longer report:
+                # stream completed (total set) or released long ago.
+                # Evicting a LIVE producer's tombstone would let its next
+                # report resurrect an unreclaimable stream.
+                now = time.time()
+                stale = [t for t, s in self._generators.items()
+                         if s.released and (s.total is not None
+                                            or now - s.released_at > 600.0)]
+                for tid in stale[:2048]:
+                    self._generators.pop(tid, None)
 
     # ====================== placement groups ======================
 

@@ -69,7 +69,7 @@ def _load() -> ctypes.CDLL:
     lib.rt_store_destroy.argtypes = [ctypes.c_char_p]
     lib.rt_store_prefault.restype = None
     lib.rt_store_prefault.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                      ctypes.c_uint32]
+                                      ctypes.c_uint32, ctypes.c_uint64]
     _lib = lib
     return lib
 
@@ -208,12 +208,16 @@ class NativeObjectStore:
         return memoryview(buf).cast("B").toreadonly()
 
     def prefault(self, chunk_bytes: int = 64 * 1024 * 1024,
-                 sleep_us: int = 2000) -> None:
-        """Touch every arena page (content-preserving) so puts never pay
+                 sleep_us: int = 2000, max_bytes: int = 0) -> None:
+        """Touch arena pages (content-preserving) so puts don't pay
         first-fault page population; run from a background thread — ctypes
-        releases the GIL for the call's duration."""
+        releases the GIL for the call's duration. The native side drops the
+        thread to SCHED_IDLE so this never competes with real work.
+        ``max_bytes`` caps how much of the arena is touched (0 = all) so a
+        large arena on a small host doesn't balloon RSS at boot."""
         self._require_handle()
-        self._lib.rt_store_prefault(self._handle, chunk_bytes, sleep_us)
+        self._lib.rt_store_prefault(self._handle, chunk_bytes, sleep_us,
+                                    max_bytes)
 
     def release(self, object_id: bytes) -> None:
         if not self._handle:

@@ -106,8 +106,21 @@ class NodeDaemon:
             )
             # Background page prefault: fresh shm pages fault in ~10x
             # slower than rewrites under memory ballooning — pay that once
-            # at boot, off the put path.
+            # at boot, off the put path. Runs at SCHED_IDLE on the native
+            # side, and is capped to a quarter of MemAvailable so co-hosted
+            # daemons (tests: many nodes on one box) don't commit
+            # num_nodes x arena of RSS before any object exists.
+            cap_bytes = 0
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        if line.startswith("MemAvailable:"):
+                            cap_bytes = int(line.split()[1]) * 1024 // 4
+                            break
+            except OSError:
+                pass
             threading.Thread(target=self._shm.prefault,
+                             kwargs={"max_bytes": cap_bytes},
                              name="shm-prefault", daemon=True).start()
         except Exception as e:  # noqa: BLE001 — heap fallback keeps tests green
             logger.warning("native shm store unavailable (%s); heap fallback", e)
@@ -246,6 +259,14 @@ class NodeDaemon:
                       env_key: Optional[str] = None) -> _Worker:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        # CPU-only workers skip the TPU-runtime site hook: the axon
+        # sitecustomize front-loads a full jax import (~1.7s of CPU) into
+        # EVERY interpreter when PALLAS_AXON_POOL_IPS is set, which turns a
+        # worker-pool burst into seconds of boot contention on small hosts.
+        # When this node runs JAX on CPU (tests, benches, non-TPU nodes) the
+        # hook buys nothing — jax still imports lazily on first use.
+        if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_DAEMON_ADDRESS"] = self.address
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
@@ -722,6 +743,23 @@ class NodeDaemon:
                 return {"size": size, "where": "spill"}
         return None
 
+    def fetch_or_meta(self, object_id: bytes,
+                      max_bytes: int) -> Optional[dict]:
+        """Single-round-trip fetch handshake: the whole payload when the
+        replica fits ``max_bytes``, else its size so the caller opens a
+        chunked pull. Halves control-plane round trips vs the split
+        object_meta + fetch_object protocol for small daemon-resident
+        objects."""
+        meta = self.object_meta(object_id)
+        if meta is None:
+            return None
+        if meta["size"] <= max_bytes:
+            payload = self.fetch_object(object_id)
+            if payload is None:  # raced a deletion between meta and read
+                return None
+            return {"payload": payload}
+        return {"size": meta["size"]}
+
     def fetch_object_chunk(self, object_id: bytes, offset: int,
                            length: int) -> Optional[bytes]:
         """One chunk of a replica (``object_manager.cc:812`` chunked
@@ -962,6 +1000,12 @@ class NodeDaemon:
 
 
 def main(argv=None) -> int:
+    import faulthandler
+
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+    except (AttributeError, ValueError):
+        pass
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs", required=True)
     parser.add_argument("--resources", default="{}")

@@ -117,3 +117,11 @@ class ObjectRefGenerator:
             raise StopAsyncIteration
         self._next_index += 1
         return ref
+
+    def __del__(self):
+        # Reclaim owner-side stream state + never-consumed inline items
+        # (they were registered owned at report time and have no handles).
+        try:
+            self._runtime.release_generator(self._task_id)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

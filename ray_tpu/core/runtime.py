@@ -873,6 +873,11 @@ class Runtime:
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(None, self.next_generator_item, task_id, index)
 
+    def release_generator(self, task_id: TaskID) -> None:
+        """In-process runtime keeps generator items in the task record, which
+        the task table already reclaims; nothing extra to free here (the
+        CoreWorker counterpart collects owner-cache stream state)."""
+
     # -- actors (core_worker.cc:2139 CreateActor, :2377 SubmitActorTask) ------
 
     def create_actor(self, spec: TaskSpec) -> ActorID:

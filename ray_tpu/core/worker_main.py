@@ -400,11 +400,26 @@ class WorkerService:
         whatever arrives first IS that handle's oldest outstanding call.
         """
         deadline = time.time() + timeout
+        window_min = spec.window_min
+        if window_min < 0:  # spec built outside the pipelined transport
+            window_min = spec.sequence_number
         with state.cv:
             if spec.caller_id not in state.next_seq:
-                state.next_seq[spec.caller_id] = spec.sequence_number + 1
+                # Baseline = the handle's lowest OUTSTANDING seq at the
+                # sender's window (window_min), NOT this request's own
+                # seq: with a pipelined client, pool threads can reach this
+                # point out of order, and baselining on the first ARRIVAL
+                # would let seq 1 run before seq 0.
+                state.next_seq[spec.caller_id] = min(window_min,
+                                                     spec.sequence_number)
                 state.cv.notify_all()
-                return
+            elif window_min > state.next_seq[spec.caller_id]:
+                # The client promises nothing below window_min is still
+                # outstanding (earlier seqs were acked or dropped client-
+                # side before sending): fast-forward past the gap instead
+                # of starving every later call behind it.
+                state.next_seq[spec.caller_id] = window_min
+                state.cv.notify_all()
             while state.next_seq[spec.caller_id] < spec.sequence_number:
                 remaining = deadline - time.time()
                 if remaining <= 0:
@@ -413,7 +428,10 @@ class WorkerService:
                         f"{spec.caller_id[:8]} starved (expected "
                         f"{state.next_seq.get(spec.caller_id, 0)})")
                 state.cv.wait(timeout=min(remaining, 1.0))
-            state.next_seq[spec.caller_id] = spec.sequence_number + 1
+            # max(): a duplicate/straggler below next_seq must never rewind
+            # the admission cursor (that wedges every later call).
+            state.next_seq[spec.caller_id] = max(
+                state.next_seq[spec.caller_id], spec.sequence_number + 1)
             state.cv.notify_all()
 
     # ====================== lifecycle ======================
@@ -442,8 +460,21 @@ def _die_with_parent() -> None:
         pass
 
 
+def _install_stack_dumper() -> None:
+    """SIGUSR1 → dump all thread stacks to stderr (lands in the worker's
+    session log). Debug aid for live hangs/spins on running clusters."""
+    import faulthandler
+    import signal
+
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+    except (AttributeError, ValueError):  # non-main thread / platform
+        pass
+
+
 def main() -> int:
     _die_with_parent()
+    _install_stack_dumper()
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     daemon_address = os.environ["RAY_TPU_DAEMON_ADDRESS"]
     gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]

@@ -247,3 +247,69 @@ def test_serve_streams_tokens_cross_process(driver):
         assert arrivals[0][1] < arrivals[-1][1] - 0.7, arrivals
     finally:
         serve.shutdown()
+
+
+def test_admit_in_order_pipelined_races():
+    """Unit-level: the server's admission protocol under a pipelined client.
+
+    Pool threads can reach _admit_in_order in ANY arrival order; the
+    window_min baseline (task_spec.py window_min) must still admit strictly
+    by sequence number, never rewind the cursor, and fast-forward past
+    client-side-dropped seqs (reference contract:
+    sequential_actor_submit_queue.cc)."""
+    import threading
+
+    from ray_tpu.core.ids import ActorID, JobID, TaskID
+    from ray_tpu.core.task_spec import TaskOptions, TaskSpec, TaskType
+    from ray_tpu.core.worker_main import WorkerService, _ActorState
+
+    aid = ActorID.from_random()
+    state = _ActorState(aid, object(), max_concurrency=1)
+    svc = WorkerService.__new__(WorkerService)  # only _admit_in_order used
+
+    def spec(seq, window_min):
+        return TaskSpec(
+            task_id=TaskID.for_task(JobID.from_int(1), aid),
+            job_id=JobID.from_int(1), task_type=TaskType.ACTOR_TASK,
+            function_id="f", function_name="A", args=[], kwargs={},
+            options=TaskOptions(), actor_id=aid, actor_method="m",
+            sequence_number=seq, caller_id="h1", window_min=window_min)
+
+    admitted = []
+    lock = threading.Lock()
+
+    def admit(seq, wm):
+        svc._admit_in_order(state, spec(seq, wm), timeout=10.0)
+        with lock:
+            admitted.append(seq)
+
+    # Burst 0..7 (window_min=0) arriving in a hostile order: later seqs
+    # first. Each runs on its own thread like the server's pool.
+    order = [3, 1, 7, 0, 5, 2, 6, 4]
+    threads = [threading.Thread(target=admit, args=(s, 0)) for s in order]
+    for t in threads:
+        t.start()
+        time.sleep(0.02)  # force distinct arrival times in the worst order
+    for t in threads:
+        t.join(timeout=30)
+    assert admitted == list(range(8)), admitted
+
+    # Fresh incarnation mid-stream: first arrival is seq 11 but the
+    # handle's lowest outstanding is 10 -> 11 must wait for 10.
+    state2 = _ActorState(aid, object(), max_concurrency=1)
+    admitted.clear()
+    def admit2(seq, wm):
+        svc._admit_in_order(state2, spec(seq, wm), timeout=10.0)
+        with lock:
+            admitted.append(seq)
+    t11 = threading.Thread(target=admit2, args=(11, 10))
+    t10 = threading.Thread(target=admit2, args=(10, 10))
+    t11.start(); time.sleep(0.05); t10.start()
+    t11.join(timeout=30); t10.join(timeout=30)
+    assert admitted == [10, 11], admitted
+
+    # Client dropped seq 12 before sending (serialization failure):
+    # seq 13 carries window_min=13 and must not starve behind the gap.
+    t13 = threading.Thread(target=admit2, args=(13, 13))
+    t13.start(); t13.join(timeout=30)
+    assert admitted == [10, 11, 13], admitted
