@@ -14,9 +14,33 @@ compile-class efficiency) so the ratio is meaningful and stable across rounds.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 H100_GPT2_TOKENS_PER_SEC_PER_CHIP = 60_000.0
+
+
+def _acquire_devices(attempts: int = 5, base_delay: float = 20.0):
+    """TPU attach with retry/backoff: the chip rides a tunnel that can be
+    transiently UNAVAILABLE (round 3 lost its headline number to exactly
+    this). Returns a device list, or raises after bounded retries — the
+    caller turns that into a structured failure JSON, not a traceback."""
+    from ray_tpu.parallel.mesh import best_devices
+
+    last_err = None
+    for attempt in range(attempts):
+        try:
+            return best_devices()
+        except RuntimeError as e:  # jax backend init failures surface here
+            last_err = e
+            if "UNAVAILABLE" not in str(e) and "unavailable" not in str(e).lower():
+                raise
+            delay = base_delay * (attempt + 1)
+            print(json.dumps({"event": "tpu_unavailable_retry",
+                              "attempt": attempt + 1,
+                              "sleep_s": delay}), file=sys.stderr, flush=True)
+            time.sleep(delay)
+    raise last_err
 
 
 def main():
@@ -27,10 +51,22 @@ def main():
 
     from ray_tpu.models import transformer
     from ray_tpu.models.training import make_train_step
-    from ray_tpu.parallel.mesh import MeshSpec, best_devices, make_mesh
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
     from ray_tpu.parallel.sharding import ShardingRules
 
-    devices = best_devices()
+    try:
+        devices = _acquire_devices()
+    except Exception as e:  # noqa: BLE001 — emit structured failure, rc 0
+        # A perf gate that dies with a raw traceback on a flaky tunnel
+        # costs a whole round; record the failure in-band instead.
+        print(json.dumps({
+            "metric": "gpt2_train_tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tokens/s/chip",
+            "vs_baseline": None,
+            "error": f"TPU unavailable after retries: {e}",
+        }))
+        return
     n = len(devices)
     on_tpu = devices[0].platform != "cpu"
 

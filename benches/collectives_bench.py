@@ -1,0 +1,90 @@
+"""Eager-collective microbench: ring allreduce across actor processes.
+
+Prints one JSON line per (world_size, MB) cell. The headline property of
+the ring backend (vs the hub it replaced) is that per-rank traffic is
+2*(N-1)/N * size — CONSTANT in world size — so on real multi-host
+hardware wall time stays flat as N grows; on a single box total bytes
+still grow with N, so compare `per_rank_mb_moved` (the scalable quantity)
+alongside wall time.
+
+Usage:: python benches/collectives_bench.py [--mb 16] [--worlds 2,4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster, connect
+from ray_tpu.core import runtime as runtime_mod
+
+
+def bench_world(world: int, mb: int) -> dict:
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": world})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            class Member:
+                def __init__(self, rank, world):
+                    from ray_tpu.parallel import collectives as c
+
+                    c.init_collective_group(world, rank, backend="gloo",
+                                            group_name="bench")
+                    self.rank = rank
+
+                def allreduce(self, mb, repeat):
+                    from ray_tpu.parallel import collectives as c
+
+                    arr = np.ones(mb * 1024 * 1024 // 8)
+                    c.allreduce(arr, group_name="bench")  # warm
+                    t0 = time.perf_counter()
+                    for _ in range(repeat):
+                        c.allreduce(arr, group_name="bench")
+                    return (time.perf_counter() - t0) / repeat
+
+            members = [Member.options(num_cpus=1).remote(r, world)
+                       for r in range(world)]
+            repeat = 3
+            times = ray_tpu.get(
+                [m.allreduce.remote(mb, repeat) for m in members],
+                timeout=600)
+            dt = max(times)
+            size = mb * 1024 * 1024
+            return {
+                "metric": "ring_allreduce",
+                "world": world,
+                "mb": mb,
+                "wall_s": round(dt, 4),
+                "per_rank_mb_moved": round(2 * (world - 1) / world * mb, 2),
+                "per_rank_gbps": round(2 * (world - 1) / world * size
+                                       / dt / 1e9, 3),
+            }
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=16)
+    parser.add_argument("--worlds", default="2,4")
+    args = parser.parse_args()
+    for world in [int(w) for w in args.worlds.split(",")]:
+        print(json.dumps(bench_world(world, args.mb)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,13 +148,56 @@ class _SchedulerProxy:
         return self._client.call("available_resources")
 
 
-class _LocalRefCounter:
-    """Process-local reference counting; owner frees cluster-wide on zero.
+# Thread-local deserialization context for the borrow protocol: while a
+# worker deserializes TASK ARGUMENTS, foreign refs constructed there are
+# recorded in this set and registered with their owners only if still held
+# at task completion (the caller's call-duration pin covers the interim) —
+# the reference piggybacks borrower bookkeeping on task replies the same
+# way (reference_count.h:61 "borrowers"). Everywhere else (get() values,
+# user code), a foreign ref registers with its owner synchronously at
+# construction.
+_BORROW_CTX = threading.local()
 
-    Simplified from ``reference_count.h:61``: each process counts its own
-    Python handles + in-flight submitted-task borrows; only the *owner*
-    (creating process) triggers a cluster-wide free, so non-owner processes
-    dropping their copies can never delete an object they borrowed.
+
+def _arg_borrow_set() -> Optional[set]:
+    return getattr(_BORROW_CTX, "arg_set", None)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def arg_borrow_scope():
+    """Open the deferred-registration scope for task-argument
+    deserialization; yields the set of candidate borrowed oids."""
+    prev = getattr(_BORROW_CTX, "arg_set", None)
+    out: set = set()
+    _BORROW_CTX.arg_set = out
+    try:
+        yield out
+    finally:
+        _BORROW_CTX.arg_set = prev
+
+
+class _LocalRefCounter:
+    """Distributed reference counting: local handles + submitted-task pins
+    + the borrower protocol of ``reference_count.h:61``.
+
+    Each process counts its own Python handles and in-flight submitted-task
+    borrows. Only the *owner* (creating process) triggers a cluster-wide
+    free — and defers it while any remote process is REGISTERED as a
+    borrower or any live local object CONTAINS the ref (nested refs).
+    Borrower registrations flow:
+
+    - handle borrows: a process that deserializes a foreign ref registers
+      with the owner (synchronously in value context; deferred to task
+      completion for task args, covered by the caller's pin meanwhile);
+    - contained refs: serializing a value holding refs pins the inner refs
+      on the OUTER object's owner until the outer is freed; a worker
+      returning such a value registers the caller as borrower before
+      replying (handover — no window where nothing pins the inner);
+    - worker death: owners sweep borrower addresses and purge unreachable
+      ones (the reference collects borrower sets on worker exit).
     """
 
     def __init__(self, core: "CoreWorker"):
@@ -163,14 +206,40 @@ class _LocalRefCounter:
         self._local: Dict[ObjectID, int] = {}
         self._submitted: Dict[ObjectID, int] = {}
         self._owned: set = set()
+        # Owner side: oid -> {borrower owner-service addr: registrations}.
+        self._borrowers: Dict[ObjectID, Dict[str, int]] = {}
+        # Both sides: inner oid -> count of live local outer objects
+        # holding it (participates in the owner's free condition and in
+        # the borrower's deregistration condition).
+        self._contained: Dict[ObjectID, int] = {}
+        # outer oid -> [(inner oid, remote owner addr or None, registered)]
+        self._contained_by: Dict[ObjectID, list] = {}
+        # Borrower side: borrowed oid -> owner addr; and which oids hold a
+        # HANDLE registration with their owner (at most one per oid —
+        # contained-pin registrations are tracked per _contained_by entry).
+        self._borrowed_owner: Dict[ObjectID, str] = {}
+        self._handle_reg: set = set()
 
     def set_owned(self, object_id: ObjectID) -> None:
         with self._lock:
             self._owned.add(object_id)
 
-    def add_local_reference(self, object_id: ObjectID) -> None:
+    def add_local_reference(self, object_id: ObjectID,
+                            owner_hint: Optional[str] = None) -> None:
+        register = None
         with self._lock:
             self._local[object_id] = self._local.get(object_id, 0) + 1
+            if (owner_hint and object_id not in self._owned
+                    and owner_hint != self._core.owner_address):
+                self._borrowed_owner.setdefault(object_id, owner_hint)
+                arg_set = _arg_borrow_set()
+                if arg_set is not None:
+                    arg_set.add(object_id)  # defer: caller's pin covers us
+                elif object_id not in self._handle_reg:
+                    self._handle_reg.add(object_id)
+                    register = self._borrowed_owner[object_id]
+        if register:
+            self._core._register_borrow(object_id, register)
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
         self._dec(self._local, object_id)
@@ -182,32 +251,159 @@ class _LocalRefCounter:
     def remove_submitted_task_reference(self, object_id: ObjectID) -> None:
         self._dec(self._submitted, object_id)
 
+    # -- owner side: borrower sets ------------------------------------------
+
+    def add_borrower(self, object_id: ObjectID, addr: str) -> bool:
+        """A remote process (addr = its owner-service address) borrows an
+        object this process owns. False if the object is already freed."""
+        with self._lock:
+            if object_id not in self._owned:
+                return False
+            d = self._borrowers.setdefault(object_id, {})
+            d[addr] = d.get(addr, 0) + 1
+            return True
+
+    def remove_borrower(self, object_id: ObjectID, addr: str) -> None:
+        free = False
+        with self._lock:
+            d = self._borrowers.get(object_id)
+            if d is not None and addr in d:
+                d[addr] -= 1
+                if d[addr] <= 0:
+                    del d[addr]
+                if not d:
+                    del self._borrowers[object_id]
+            free = self._maybe_free_locked(object_id)
+        if free:
+            self._core._free_object(object_id)
+
+    def purge_borrower_addr(self, addr: str) -> None:
+        """Drop a dead borrower process from every borrower set (the
+        owner-collects-borrowers-on-worker-exit half of the protocol)."""
+        to_free = []
+        with self._lock:
+            for oid in list(self._borrowers):
+                if addr in self._borrowers[oid]:
+                    del self._borrowers[oid][addr]
+                    if not self._borrowers[oid]:
+                        del self._borrowers[oid]
+                        if self._maybe_free_locked(oid):
+                            to_free.append(oid)
+        for oid in to_free:
+            self._core._free_object(oid)
+
+    def borrower_addrs(self) -> set:
+        with self._lock:
+            out: set = set()
+            for d in self._borrowers.values():
+                out.update(d)
+            return out
+
+    # -- contained refs (refs inside objects / actor state) -----------------
+
+    def pin_contained(self, outer_oid: ObjectID, inners,
+                      already_registered: bool) -> None:
+        """Pin refs discovered while serializing ``outer_oid``'s value;
+        called by the OUTER object's owner. ``inners`` is a list of
+        (ObjectID, owner_addr or None). ``already_registered``: a worker
+        already registered this process with the inner owners (return-value
+        handover), so only record the matching release obligation."""
+        to_register = []
+        with self._lock:
+            entries = self._contained_by.setdefault(outer_oid, [])
+            for oid, owner_addr in inners:
+                self._contained[oid] = self._contained.get(oid, 0) + 1
+                remote = (owner_addr and oid not in self._owned
+                          and owner_addr != self._core.owner_address)
+                if remote:
+                    self._borrowed_owner.setdefault(oid, owner_addr)
+                entries.append((oid, owner_addr if remote else None,
+                                bool(remote)))
+                if remote and not already_registered:
+                    to_register.append((oid, owner_addr))
+        for oid, addr in to_register:
+            self._core._register_borrow(oid, addr)
+
+    def release_contained(self, outer_oid: ObjectID) -> None:
+        """The outer object was freed: drop its inner pins (cascading owned
+        frees and remote deregistrations)."""
+        notify = []
+        to_free = []
+        with self._lock:
+            for oid, addr, registered in self._contained_by.pop(outer_oid, []):
+                n = self._contained.get(oid, 0) - 1
+                if n > 0:
+                    self._contained[oid] = n
+                else:
+                    self._contained.pop(oid, None)
+                if registered and addr:
+                    notify.append((oid, addr))
+                if self._maybe_free_locked(oid):
+                    to_free.append(oid)
+        for oid, addr in notify:
+            self._core._deregister_borrow(oid, addr)
+        for oid in to_free:
+            self._core._free_object(oid)
+
+    # -- worker-side completion handover ------------------------------------
+
+    def retained_arg_borrows(self, candidates: set) -> list:
+        """Which deferred arg borrows are still held at task completion —
+        these must be registered with their owners BEFORE the reply releases
+        the caller's pin. Marks them handle-registered (the caller of this
+        method performs the actual RPCs)."""
+        retained = []
+        with self._lock:
+            for oid in candidates:
+                if ((self._local.get(oid) or self._submitted.get(oid)
+                     or self._contained.get(oid))
+                        and oid in self._borrowed_owner
+                        and oid not in self._handle_reg):
+                    self._handle_reg.add(oid)
+                    retained.append((oid, self._borrowed_owner[oid]))
+        return retained
+
+    # -- internals -----------------------------------------------------------
+
+    def _maybe_free_locked(self, object_id: ObjectID) -> bool:
+        """Owner-side free check; caller holds ``self._lock``."""
+        if (object_id in self._owned
+                and not self._local.get(object_id)
+                and not self._submitted.get(object_id)
+                and not self._contained.get(object_id)
+                and not self._borrowers.get(object_id)):
+            self._owned.discard(object_id)
+            return True
+        return False
+
     def _dec(self, table: Dict[ObjectID, int], object_id: ObjectID) -> None:
         free = False
+        deregister = None
         with self._lock:
             n = table.get(object_id, 0) - 1
             if n > 0:
                 table[object_id] = n
             else:
                 table.pop(object_id, None)
-            if (object_id in self._owned
+            free = self._maybe_free_locked(object_id)
+            if (not free and object_id in self._handle_reg
                     and not self._local.get(object_id)
-                    and not self._submitted.get(object_id)):
-                self._owned.discard(object_id)
-                free = True
+                    and not self._submitted.get(object_id)
+                    and not self._contained.get(object_id)):
+                # Last local use of a borrowed ref: tell the owner.
+                self._handle_reg.discard(object_id)
+                deregister = self._borrowed_owner.pop(object_id, None)
         if free:
             self._core._free_object(object_id)
+        elif deregister:
+            self._core._deregister_borrow(object_id, deregister)
 
     def drop_owned_if_unreferenced(self, object_id: ObjectID) -> None:
         """Free an owned object that never got (or no longer has) any local
         handle — e.g. generator items the consumer abandoned mid-stream."""
         free = False
         with self._lock:
-            if (object_id in self._owned
-                    and not self._local.get(object_id)
-                    and not self._submitted.get(object_id)):
-                self._owned.discard(object_id)
-                free = True
+            free = self._maybe_free_locked(object_id)
         if free:
             self._core._free_object(object_id)
 
@@ -232,13 +428,14 @@ class _ActorCall:
     """One submitted actor call held until its reply is acked (the resend
     unit of the pipelined actor transport)."""
 
-    __slots__ = ("spec", "pending", "spec_bytes", "pinned")
+    __slots__ = ("spec", "pending", "spec_bytes", "pinned", "nested_deps")
 
     def __init__(self, spec: TaskSpec, pending: _PendingTask):
         self.spec = spec
         self.pending = pending
         self.spec_bytes: Optional[bytes] = None  # serialized lazily, reused
         self.pinned = True  # argument refs pinned until terminal
+        self.nested_deps: Optional[list] = None  # refs inside arg values
 
 
 class _LeasedWorker:
@@ -257,11 +454,21 @@ class _LeasedWorker:
 
 
 class _QueuedTask:
-    __slots__ = ("spec", "spec_bytes", "pending", "attempt")
+    __slots__ = ("spec", "spec_bytes", "pending", "attempt", "nested_deps")
 
-    def __init__(self, spec: TaskSpec, pending: _PendingTask):
+    def __init__(self, spec: TaskSpec, pending: _PendingTask,
+                 refcounter: Optional["_LocalRefCounter"] = None):
         self.spec = spec
-        self.spec_bytes = serialization.dumps(spec)
+        with serialization.collecting_refs() as refs:
+            self.spec_bytes = serialization.dumps(spec)
+        # Refs nested inside arg VALUES (spec.dependencies() covers only
+        # top-level ref args): pin them for the task's duration so the
+        # callee's deferred borrow registration has cover (_finish_task
+        # releases them).
+        self.nested_deps = [r.id for r in refs]
+        if refcounter is not None:
+            for oid in self.nested_deps:
+                refcounter.add_submitted_task_reference(oid)
         self.pending = pending
         self.attempt = 0
 
@@ -429,6 +636,21 @@ class _OwnerService:
         with state.lock:
             return state.consumed
 
+    # -- borrower protocol (reference_count.h:61) -------------------------
+
+    def add_borrower(self, oid_bytes: bytes, addr: str) -> bool:
+        """A remote process registers as borrower of an object WE own.
+        False = already freed (the borrower treats the ref as lost)."""
+        ok = self._core.reference_counter.add_borrower(ObjectID(oid_bytes),
+                                                       addr)
+        if ok:
+            self._core._ensure_borrower_sweeper()
+        return ok
+
+    def remove_borrower(self, oid_bytes: bytes, addr: str) -> None:
+        self._core.reference_counter.remove_borrower(ObjectID(oid_bytes),
+                                                     addr)
+
     def ping(self) -> str:
         return "pong"
 
@@ -532,6 +754,7 @@ class CoreWorker:
         self._owner_down: Dict[str, tuple] = {}
         self._ready_probe: Dict[ObjectID, float] = {}  # wait() probe throttle
         self._ready_probe_sweep = 0.0  # next allowed eviction sweep
+        self._borrow_sweeper_started = False
         self._pull = None  # lazy PullManager (chunked node-to-node fetches)
 
         # Execution context (worker mode fills these per task).
@@ -557,7 +780,15 @@ class CoreWorker:
         with self._cache_cv:
             self._cache[oid] = value
             self._cache_cv.notify_all()
-        ser = serialization.serialize(value)
+        with serialization.collecting_refs() as inner_refs:
+            ser = serialization.serialize(value)
+        if inner_refs:
+            # The sealed value CONTAINS refs: pin them for the object's
+            # lifetime (nested-ref borrow protocol) — a consumer extracting
+            # them later is covered until this outer object is freed.
+            self.reference_counter.pin_contained(
+                oid, [(r.id, r._owner_hint) for r in inner_refs],
+                already_registered=False)
         size = ser.framed_size()
         if size <= config().max_inline_object_size:
             # Small objects stay in the owner's cache and are served by the
@@ -633,10 +864,61 @@ class CoreWorker:
             logger.warning("local daemon unreachable; object %s is cache-only",
                            oid.hex()[:12])
 
+    # -- borrower protocol plumbing (reference_count.h:61) -------------------
+
+    def _register_borrow(self, oid: ObjectID, owner_addr: str) -> bool:
+        """Synchronously register this process as a borrower with the
+        object's owner. False = the owner already freed it (the ref then
+        resolves like any lost object)."""
+        try:
+            ok = bool(self._owner_clients.get(owner_addr).call(
+                "add_borrower", oid.binary(), self.owner_address,
+                timeout=30.0))
+        except (RpcConnectionError, TimeoutError):
+            return False
+        return ok
+
+    def _deregister_borrow(self, oid: ObjectID, owner_addr: str) -> None:
+        try:
+            self._owner_clients.get(owner_addr).notify(
+                "remove_borrower", oid.binary(), self.owner_address)
+        except RpcConnectionError:
+            pass  # owner gone; nothing left to free remotely
+
+    def _ensure_borrower_sweeper(self) -> None:
+        if self._borrow_sweeper_started:
+            return
+        self._borrow_sweeper_started = True
+        threading.Thread(target=self._sweep_dead_borrowers,
+                         name="borrow-sweeper", daemon=True).start()
+
+    def _sweep_dead_borrowers(self) -> None:
+        """Owner side: purge borrower processes that died without
+        deregistering (the reference's on-worker-exit borrower collection;
+        here by probing each borrower's owner-service address)."""
+        strikes: Dict[str, int] = {}
+        while not self._shutdown:
+            time.sleep(5.0)
+            addrs = self.reference_counter.borrower_addrs()
+            for addr in list(strikes):
+                if addr not in addrs:
+                    strikes.pop(addr, None)
+            for addr in addrs:
+                try:
+                    self._owner_clients.get(addr).call("ping", timeout=5.0)
+                    strikes.pop(addr, None)
+                except (RpcConnectionError, TimeoutError):
+                    strikes[addr] = strikes.get(addr, 0) + 1
+                    if strikes[addr] >= 2:
+                        strikes.pop(addr, None)
+                        self._owner_clients.invalidate(addr)
+                        self.reference_counter.purge_borrower_addr(addr)
+
     def _free_object(self, oid: ObjectID) -> None:
         """Owner-side free: drop the local value now, batch the cluster-wide
         free (one note per ~100 objects / 100 ms instead of one per ref —
         the reference batches frees the same way in its io_service)."""
+        self.reference_counter.release_contained(oid)
         with self._cache_lock:
             self._cache.pop(oid, None)
             self._inline_owned.pop(oid, None)
@@ -1037,7 +1319,8 @@ class CoreWorker:
             # applied at process start — the daemon owns that; no reuse.
             self._submit_pool.submit(self._run_submission, spec, pending)
         else:
-            self._dispatch(_QueuedTask(spec, pending))
+            self._dispatch(_QueuedTask(spec, pending,
+                                       refcounter=self.reference_counter))
 
     # ---------------- direct task transport ----------------
 
@@ -1348,6 +1631,8 @@ class CoreWorker:
             self._record_task_error(task.spec, task.pending, error)
         for dep in task.spec.dependencies():
             self.reference_counter.remove_submitted_task_reference(dep)
+        for oid in task.nested_deps:
+            self.reference_counter.remove_submitted_task_reference(oid)
 
     def _release_entry(self, entry: _LeasedWorker) -> None:
         try:
@@ -1416,7 +1701,11 @@ class CoreWorker:
                 continue
 
     def _run_submission_inner(self, spec: TaskSpec, pending: _PendingTask) -> None:
-        spec_bytes = serialization.dumps(spec)
+        with serialization.collecting_refs() as _nested:
+            spec_bytes = serialization.dumps(spec)
+        nested_deps = [r.id for r in _nested]
+        for oid in nested_deps:
+            self.reference_counter.add_submitted_task_reference(oid)
         resources = spec.declared_resources()
         max_retries = spec.options.max_retries
         attempt = 0
@@ -1474,6 +1763,8 @@ class CoreWorker:
         finally:
             for dep in spec.dependencies():
                 self.reference_counter.remove_submitted_task_reference(dep)
+            for oid in nested_deps:
+                self.reference_counter.remove_submitted_task_reference(oid)
 
     def _record_task_results(self, spec: TaskSpec, pending: _PendingTask,
                              result: dict) -> None:
@@ -1495,6 +1786,15 @@ class CoreWorker:
             for oid in pending.refs:
                 self._pending.pop(oid, None)
             self._cache_cv.notify_all()
+        # Nested-ref handover: the worker already registered us (the outer
+        # objects' owner) as borrower of every contained ref before
+        # replying; record the matching release obligations so freeing a
+        # return object releases what it contains.
+        for outer_bytes, inners in (result.get("contained") or {}).items():
+            self.reference_counter.pin_contained(
+                ObjectID(outer_bytes),
+                [(ObjectID(ib), addr) for ib, addr in inners],
+                already_registered=True)
         if result.get("generator_items") is not None:
             # Completion record: merge (streamed reports may already have
             # filled items) and mark the stream done.
@@ -1662,7 +1962,13 @@ class CoreWorker:
                 # spec_bytes so resends recompute it).
                 call.spec.window_min = min(st["inflight"], default=seq)
                 try:
-                    call.spec_bytes = serialization.dumps(call.spec)
+                    with serialization.collecting_refs() as _nested:
+                        call.spec_bytes = serialization.dumps(call.spec)
+                    if call.nested_deps is None:  # once, not per resend
+                        call.nested_deps = [r.id for r in _nested]
+                        for noid in call.nested_deps:
+                            self.reference_counter \
+                                .add_submitted_task_reference(noid)
                 except BaseException as exc:  # noqa: BLE001 — unpicklable arg
                     self._finish_actor_call(call)
                     self._record_task_error(
@@ -1670,6 +1976,17 @@ class CoreWorker:
                         TaskError.from_exception(
                             f"{call.spec.function_name}."
                             f"{call.spec.actor_method}", exc))
+                    # Tell the server this seq will never arrive: with
+                    # OLDER calls still in flight, later calls'
+                    # window_min can't fast-forward past an interior gap
+                    # and would starve behind it (worker_main
+                    # skip_actor_seq + _admit_in_order).
+                    try:
+                        self._actor_clients.get(addr).notify(
+                            "skip_actor_seq", call.spec.actor_id.binary(),
+                            call.spec.caller_id, seq)
+                    except (RpcConnectionError, OSError):
+                        pass  # conn loss → recovery resends recompute
                     continue
             client = self._actor_clients.get(addr)
             st["inflight"][seq] = (call, addr)
@@ -1842,6 +2159,8 @@ class CoreWorker:
             call.pinned = False
             for dep in call.spec.dependencies():
                 self.reference_counter.remove_submitted_task_reference(dep)
+            for noid in (call.nested_deps or ()):
+                self.reference_counter.remove_submitted_task_reference(noid)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._actor_addr_cache.pop(actor_id, None)

@@ -31,7 +31,10 @@ class ObjectRef:
         self._owner_hint = owner_hint
         rt = _maybe_runtime()
         if rt is not None:
-            rt.reference_counter.add_local_reference(object_id)
+            # The owner hint rides along so a foreign ref registers this
+            # process as a BORROWER with the object's owner
+            # (reference_count.h:61; see _LocalRefCounter).
+            rt.reference_counter.add_local_reference(object_id, owner_hint)
 
     @property
     def id(self) -> ObjectID:
@@ -63,6 +66,14 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        # Serialize-time collection: a value being put/returned that
+        # CONTAINS refs must pin them on the outer object's owner until the
+        # outer is freed (nested-ref half of the borrow protocol). The
+        # serializer opens a collection scope; every ref pickled inside it
+        # lands here.
+        from ray_tpu.core import serialization as _ser
+
+        _ser.note_serialized_ref(self)
         return (ObjectRef, (self._id, self._owner_hint))
 
     def __del__(self):

@@ -43,7 +43,10 @@ class ReferenceCounter:
         self._refs: Dict[ObjectID, _Ref] = {}
         self._on_release = on_release
 
-    def add_local_reference(self, object_id: ObjectID) -> None:
+    def add_local_reference(self, object_id: ObjectID,
+                            owner_hint: Optional[str] = None) -> None:
+        # owner_hint is part of the shared ObjectRef contract; in-process
+        # mode has a single owner so the borrow protocol collapses here.
         with self._lock:
             self._refs.setdefault(object_id, _Ref()).local += 1
 

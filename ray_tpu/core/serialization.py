@@ -15,12 +15,37 @@ equivalent of the reference's GPU-object support.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import pickle
+import threading
 from dataclasses import dataclass
 
 import cloudpickle
 import numpy as np
+
+# Serialize-time ObjectRef collection (nested-ref borrow protocol): while a
+# collection scope is open on this thread, ObjectRef.__reduce__ records every
+# ref pickled. Scopes nest (spec serialization inside value serialization).
+_COLLECT = threading.local()
+
+
+def note_serialized_ref(ref) -> None:
+    lst = getattr(_COLLECT, "refs", None)
+    if lst is not None:
+        lst.append(ref)
+
+
+@contextlib.contextmanager
+def collecting_refs():
+    """Collect ObjectRefs pickled on this thread; yields the list."""
+    prev = getattr(_COLLECT, "refs", None)
+    out: list = []
+    _COLLECT.refs = out
+    try:
+        yield out
+    finally:
+        _COLLECT.refs = prev
 
 _JAX_ARRAY_TYPES: tuple = ()
 

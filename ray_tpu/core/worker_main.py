@@ -50,6 +50,9 @@ class _ActorState:
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.next_seq: Dict[str, int] = {}  # caller_id -> next expected seq
+        # Seqs the client dropped before sending (unpicklable args): the
+        # admission loop steps over them instead of waiting forever.
+        self.skipped: Dict[str, set] = {}
         self.slots = threading.Semaphore(max(1, max_concurrency))
         self.serial = max_concurrency <= 1
         self.loop: Optional[asyncio.AbstractEventLoop] = None  # async actors
@@ -134,18 +137,24 @@ class WorkerService:
     # ====================== normal tasks ======================
 
     def run_task(self, spec_bytes: bytes, lease_id: str | None = None) -> dict:
+        from ray_tpu.core.core_worker import arg_borrow_scope
+
         spec: TaskSpec = serialization.loads(spec_bytes)
         self.core.current_task_id = spec.task_id
         st = {"lease_id": lease_id,
               "resources": spec.declared_resources(), "released": False}
         self._task_lease.value = st
+        borrowed: set = set()
         try:
             fn = self.core.gcs.get_function(spec.function_id)
             if fn is None:
                 raise RuntimeError(f"function {spec.function_id} not in GCS")
-            args, kwargs = self._resolve_args(spec)
+            with arg_borrow_scope() as borrowed:
+                args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
+            args = kwargs = None  # drop frame pins before the borrow audit
             out = self._package_results(spec, result, lineage=spec_bytes)
+            result = None
         except _DependencyFailed as df:
             out = self._package_error(spec, df.error)
         except BaseException as exc:  # noqa: BLE001 — wire to the caller
@@ -154,11 +163,58 @@ class WorkerService:
         finally:
             self._task_lease.value = None
             self.core.current_task_id = None
+        # Borrow handover BEFORE the reply: the caller's call-duration pin
+        # is released when it processes this reply, so any arg ref this
+        # process still holds must be registered with its owner first
+        # (reference_count.h:61 borrower reporting on task completion).
+        self._handover_borrows(borrowed)
         # IN-BAND lease report: blocked-release may have swapped (or shed)
         # the lease mid-task; telling the daemon in the reply — the same
         # channel it releases on — makes the ordering deterministic (the
         # side-channel notify only covers the worker-crash case).
         out["final_lease_id"] = None if st["released"] else st["lease_id"]
+        return out
+
+    def _handover_borrows(self, candidates: set) -> None:
+        """Register still-held arg borrows with their owners, synchronously,
+        before the task reply releases the caller's pins."""
+        if not candidates:
+            return
+        retained = self.core.reference_counter.retained_arg_borrows(candidates)
+        for oid, addr in retained:
+            try:
+                self.core._owner_clients.get(addr).call(
+                    "add_borrower", oid.binary(), self.core.owner_address,
+                    timeout=30.0)
+            except (RpcConnectionError, TimeoutError):
+                pass  # owner gone; the object is already lost
+
+    def _register_return_contained(self, spec: TaskSpec, inner_refs) -> list:
+        """A return value CONTAINS refs: register the CALLER (the return
+        object's owner) as borrower of each before replying — the handover
+        that makes nested refs in results safe with no unpinned window.
+        Returns the (inner id, owner addr) list to ride in the reply."""
+        out = []
+        for r in inner_refs:
+            owner_addr = r._owner_hint
+            if not owner_addr:
+                continue  # legacy/untracked ref
+            out.append((r.id.binary(), owner_addr))
+            if owner_addr == spec.owner_addr:
+                # Caller owns the inner ref: it pins locally when it
+                # records the contained entry; no registration needed.
+                continue
+            if owner_addr == self.core.owner_address:
+                # This process owns the inner ref: register the caller
+                # directly.
+                self.core.reference_counter.add_borrower(r.id, spec.owner_addr)
+                continue
+            try:
+                self.core._owner_clients.get(owner_addr).call(
+                    "add_borrower", r.id.binary(), spec.owner_addr,
+                    timeout=30.0)
+            except (RpcConnectionError, TimeoutError):
+                pass  # inner owner gone; ref is lost regardless
         return out
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
@@ -206,13 +262,22 @@ class WorkerService:
                 f"returned {len(values)} values"
             )
         returns = []
+        contained: Dict[bytes, list] = {}
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
-            inline = self._seal_return(oid, value,
-                                       lineage if i == 0 else None,
-                                       sealed_siblings=n > 1)
+            with serialization.collecting_refs() as inner_refs:
+                inline = self._seal_return(oid, value,
+                                           lineage if i == 0 else None,
+                                           sealed_siblings=n > 1)
+            if inner_refs:
+                entries = self._register_return_contained(spec, inner_refs)
+                if entries:
+                    contained[oid.binary()] = entries
             returns.append((oid.binary(), inline))
-        return {"ok": True, "returns": returns}
+        out = {"ok": True, "returns": returns}
+        if contained:
+            out["contained"] = contained
+        return out
 
     def _stream_generator(self, spec: TaskSpec, result, lineage) -> dict:
         """Drive a generator task INCREMENTALLY: every item is reported to
@@ -354,6 +419,9 @@ class WorkerService:
                 spec, ActorError(spec.actor_id.hex(),
                                  "actor not hosted by this worker"))
         self._admit_in_order(state, spec)
+        from ray_tpu.core.core_worker import arg_borrow_scope
+
+        borrowed: set = set()
         try:
             if spec.actor_method == DAG_LOOP_METHOD:
                 import functools
@@ -367,7 +435,8 @@ class WorkerService:
                 raise AttributeError(
                     f"actor {spec.function_name} has no method "
                     f"'{spec.actor_method}'")
-            args, kwargs = self._resolve_args(spec)
+            with arg_borrow_scope() as borrowed:
+                args, kwargs = self._resolve_args(spec)
             if inspect.iscoroutinefunction(method):
                 loop = state.ensure_loop()
                 fut = asyncio.run_coroutine_threadsafe(
@@ -379,14 +448,38 @@ class WorkerService:
             else:
                 with state.slots:
                     result = method(*args, **kwargs)
-            return self._package_results(spec, result)
+            args = kwargs = None  # drop frame pins before the borrow audit
+            out = self._package_results(spec, result)
+            result = None
         except _DependencyFailed as df:
-            return self._package_error(spec, df.error)
+            out = self._package_error(spec, df.error)
         except BaseException as exc:  # noqa: BLE001
-            return self._package_error(
+            out = self._package_error(
                 spec,
                 TaskError.from_exception(
                     f"{spec.function_name}.{spec.actor_method}", exc))
+        # Borrow handover before the reply (see run_task): an arg ref the
+        # method stored in ACTOR STATE must be registered with its owner
+        # before the caller's call-duration pin is released.
+        self._handover_borrows(borrowed)
+        return out
+
+    def skip_actor_seq(self, actor_id_bytes: bytes, caller_id: str,
+                       seq: int) -> None:
+        """The client dropped this sequence number before sending it
+        (serialization failure): admission must step over it, or every
+        later call from the handle starves behind the gap."""
+        with self._actors_lock:
+            state = self._actors.get(ActorID(actor_id_bytes))
+        if state is None:
+            return
+        with state.cv:
+            state.skipped.setdefault(caller_id, set()).add(seq)
+            cur = state.next_seq.get(caller_id)
+            if cur is not None and cur == seq:
+                state.next_seq[caller_id] = seq + 1
+                state.skipped[caller_id].discard(seq)
+            state.cv.notify_all()
 
     def _admit_in_order(self, state: _ActorState, spec: TaskSpec,
                         timeout: float = 300.0) -> None:
@@ -421,6 +514,11 @@ class WorkerService:
                 state.next_seq[spec.caller_id] = window_min
                 state.cv.notify_all()
             while state.next_seq[spec.caller_id] < spec.sequence_number:
+                skipped = state.skipped.get(spec.caller_id)
+                if skipped and state.next_seq[spec.caller_id] in skipped:
+                    skipped.discard(state.next_seq[spec.caller_id])
+                    state.next_seq[spec.caller_id] += 1
+                    continue
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise TimeoutError(

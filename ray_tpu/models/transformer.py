@@ -172,19 +172,31 @@ def param_count(params) -> int:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _dense_attention(q, k, v, *, scale: float):
-    """Causal full attention in f32. q/k/v: [B, L, H, Dh]."""
+def _dense_attention(q, k, v, *, scale: float, cstr=None):
+    """Causal full attention in f32. q/k/v: [B, L, H, Dh].
+
+    ``cstr(x, logical)`` (optional) pins intermediate shardings: without
+    it, the seq×tensor layout transition around the two einsums makes the
+    SPMD partitioner fall back to "involuntary full rematerialization"
+    (replicate-then-repartition) on the activation reshapes — a real
+    all-to-all's worth of extra traffic on hardware.
+    """
     l = q.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    if cstr is not None:
+        scores = cstr(scores, ("batch", "heads", "seq_act", None))
     scores = scores * scale
     mask = jnp.tril(jnp.ones((l, l), bool))
     scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    if cstr is not None:
+        out = cstr(out, ("batch", "seq_act", "heads", "head_dim"))
     return out.astype(q.dtype)
 
 
-def _make_attention(config: TransformerConfig, mesh: Optional[Mesh]):
+def _make_attention(config: TransformerConfig, mesh: Optional[Mesh],
+                    rules: Optional[ShardingRules] = None):
     scale = 1.0 / config.head_dim ** 0.5
     impl = config.attn_impl
     # Largest power-of-two block ≤512 that divides the sequence, so the
@@ -208,6 +220,10 @@ def _make_attention(config: TransformerConfig, mesh: Optional[Mesh]):
             q, k, v, True, scale, blk, blk, interpret
         )
     if impl == "dense" or mesh is None:
+        if mesh is not None and rules is not None:
+            return functools.partial(
+                _dense_attention, scale=scale,
+                cstr=lambda x, logical: constrain(x, mesh, rules, logical))
         return functools.partial(_dense_attention, scale=scale)
     if impl == "ring":
         from ray_tpu.parallel.ring_attention import make_ring_attention
@@ -232,7 +248,7 @@ def make_block_fn(
     where per-device code cannot carry global sharding annotations)."""
     c = config
     cast = lambda p: p.astype(c.dtype)
-    attention = _make_attention(c, mesh)
+    attention = _make_attention(c, mesh, rules)
 
     def cstr(x, logical):
         if mesh is not None and rules is not None:
@@ -289,7 +305,15 @@ def forward(
         return x
 
     B, L = tokens.shape
-    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    # Embedding lookup with an EXPLICIT table all-gather first: a gather
+    # into a vocab(tensor)-sharded table forces the SPMD partitioner into
+    # involuntary full rematerialization (replicate + repartition) inside
+    # the op; constraining the table to (None, None) turns that into one
+    # clean all-gather, and the activation constraint below re-shards the
+    # result. (Megatron's masked-lookup+psum is the large-vocab
+    # alternative; for GPT-2-class vocabs the gathered table is ~40MB bf16.)
+    tbl = cstr(cast(params["tok_embed"]), (None, None))
+    h = jnp.take(tbl, tokens, axis=0)
     positions = jnp.arange(L)
     if c.pos == "learned":
         h = h + cast(params["pos_embed"])[positions]
@@ -374,7 +398,10 @@ def pp_lm_loss(
         f"microbatch {B // num_microbatches} must divide over the "
         f"data-parallel degree {dp}")
 
-    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    # Explicit table all-gather before the lookup (see forward()): avoids
+    # the partitioner's involuntary-remat fallback on sharded-table gather.
+    tbl = constrain(cast(params["tok_embed"]), mesh, rules, (None, None))
+    h = jnp.take(tbl, tokens, axis=0)
     if c.pos == "learned":
         h = h + cast(params["pos_embed"])[jnp.arange(L)]
 

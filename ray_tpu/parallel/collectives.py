@@ -148,56 +148,247 @@ def _compute_for(descriptor: tuple, world: int):
     raise ValueError(f"unknown collective descriptor {descriptor}")
 
 
-class _GroupHubService:
-    """Rank 0's RPC surface for the cross-process ("gloo") backend.
+class _MemberService:
+    """Every rank's RPC surface in the cross-process backend: a tagged
+    mailbox. Peers deliver (tag -> payload) messages; the local rank waits
+    on its mailbox. Tags are (op_seq, step, src) so concurrent steps of
+    pipelined rounds can't mix."""
 
-    A hub topology: every rank ships its contribution to rank 0's hub,
-    which runs the same drain-guarded exchange as the local backend and
-    returns the round's result. The reference's gloo groups are likewise
-    host-side and rendezvous through a store; a ring/tree is a later
-    optimization — correctness and the API contract come first.
-    """
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.box: Dict[tuple, object] = {}
 
-    def __init__(self, world_size: int):
-        self.state = _GroupState(world_size)
+    def deliver(self, tag: tuple, value) -> None:
+        with self.cv:
+            self.box[tuple(tag)] = value
+            self.cv.notify_all()
 
-    def exchange(self, rank: int, descriptor: tuple, value):
-        compute = _compute_for(descriptor, self.state.world_size)
-        return self.state.exchange(rank, value, compute)
+    def take(self, tag: tuple, timeout: Optional[float] = 120.0):
+        import time as _time
 
-    def p2p_send(self, src: int, dst: int, value) -> None:
-        self.state.p2p_send(src, dst, value)
+        end = None if timeout is None else _time.time() + timeout
+        tag = tuple(tag)
+        with self.cv:
+            while tag not in self.box:
+                if end is None:  # block indefinitely (p2p recv contract)
+                    self.cv.wait(timeout=1.0)
+                    continue
+                remaining = end - _time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"collective step {tag} never arrived")
+                self.cv.wait(timeout=min(remaining, 1.0))
+            return self.box.pop(tag)
 
-    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
-        return self.state.p2p_recv(src, dst, timeout)
+    def ping(self) -> str:
+        return "pong"
 
 
 class _DistributedGroup:
-    """Client view of a gloo-backend group (duck-types _GroupState usage)."""
+    """One rank's view of a cross-process group: RING reduce-scatter /
+    allgather and a binomial broadcast tree over direct peer-to-peer
+    channels — each rank moves O(size) bytes per allreduce regardless of
+    world size (the rank-0 hub this replaces concentrated O(N*size) on one
+    socket). This is the host-tensor (DCN/gloo) tier of §5.8; device
+    tensors inside jitted programs use XLA collectives over ICI instead.
+    """
 
-    def __init__(self, world_size: int, hub_address: str, hub=None):
-        from ray_tpu.core.rpc import RpcClient
+    def __init__(self, world_size: int, rank: int, addrs: List[str],
+                 service: _MemberService, server):
+        from ray_tpu.core.rpc import RpcClientPool
 
         self.world_size = world_size
-        self._hub = hub  # rank 0 talks to its hub in-process
-        self._client = None if hub is not None else RpcClient(hub_address)
+        self.rank = rank
+        self._addrs = addrs
+        self._service = service
+        self._server = server  # keeps the member server alive
+        self._peers = RpcClientPool()
+        self._op_seq = 0
+        self._op_lock = threading.Lock()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._op_lock:
+            self._op_seq += 1
+            return self._op_seq
+
+    def _send(self, dst: int, tag: tuple, value) -> None:
+        if dst == self.rank:
+            self._service.deliver(tag, value)
+            return
+        self._peers.get(self._addrs[dst]).call(
+            "deliver", tag, value, timeout=120.0)
+
+    def _recv(self, tag: tuple, timeout: float = 120.0):
+        return self._service.take(tag, timeout)
+
+    # -- collectives --------------------------------------------------------
 
     def exchange_desc(self, rank: int, descriptor: tuple, value):
-        if self._hub is not None:
-            return self._hub.exchange(rank, descriptor, value)
-        return self._client.call("exchange", rank, descriptor, value,
-                                 timeout=120.0)
+        assert rank == self.rank
+        kind = descriptor[0]
+        seq = self._next_seq()
+        if kind == "allreduce":
+            return self._allreduce(seq, value, descriptor[1])
+        if kind == "reducescatter":
+            reduced = self._reduce_scatter(seq, value, descriptor[1])
+            # API contract: caller indexes [rank]; return full split list
+            # shape-compatible with the local backend.
+            out = [None] * self.world_size
+            out[self.rank] = reduced
+            return out
+        if kind == "allgather":
+            return self._allgather(seq, value)
+        if kind == "broadcast":
+            return self._broadcast(seq, value, descriptor[1])
+        if kind == "barrier":
+            self._allgather(seq, np.zeros(1, dtype=np.uint8))
+            return None
+        if kind == "alltoall":
+            return {self.rank: self._alltoall(seq, value)}
+        raise ValueError(f"unknown collective descriptor {descriptor}")
+
+    def _ring_chunks(self, arr: np.ndarray) -> List[np.ndarray]:
+        return np.array_split(arr, self.world_size, axis=0)
+
+    def _allreduce(self, seq: int, value, op: str):
+        """Ring allreduce: reduce-scatter then allgather, 2(N-1) steps,
+        each moving ~size/N bytes per rank per step."""
+        n = self.world_size
+        if n == 1:
+            return _REDUCE_OPS[op]([np.asarray(value)])
+        arr = np.asarray(value)
+        orig_shape = arr.shape
+        arr = np.atleast_1d(arr)
+        mean = op == "mean"
+        acc_op = "sum" if mean else op
+        chunks = self._ring_chunks(arr)
+        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        # Phase 1 — reduce-scatter: after step s, this rank holds the
+        # running reduction of chunk (rank - s) % n over s+1 contributors.
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self._send(nxt, (seq, "rs", step), chunks[send_idx])
+            incoming = self._recv((seq, "rs", step))
+            chunks[recv_idx] = _REDUCE_OPS[acc_op](
+                [chunks[recv_idx], np.asarray(incoming)])
+        owned = (self.rank + 1) % n  # fully reduced chunk this rank holds
+        if mean:
+            chunks[owned] = chunks[owned] / n
+        # Phase 2 — allgather the reduced chunks around the ring.
+        for step in range(n - 1):
+            send_idx = (self.rank + 1 - step) % n
+            recv_idx = (self.rank - step) % n
+            self._send(nxt, (seq, "ag", step), chunks[send_idx])
+            chunks[recv_idx] = np.asarray(self._recv((seq, "ag", step)))
+        result = np.concatenate([np.atleast_1d(c) for c in chunks], axis=0)
+        return result.reshape(orig_shape)
+
+    def _reduce_scatter(self, seq: int, value, op: str):
+        n = self.world_size
+        arr = np.asarray(value)
+        if n == 1:
+            return _REDUCE_OPS[op]([arr])
+        mean = op == "mean"
+        acc_op = "sum" if mean else op
+        chunks = self._ring_chunks(arr)
+        nxt = (self.rank + 1) % n
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self._send(nxt, (seq, "rs", step), chunks[send_idx])
+            incoming = self._recv((seq, "rs", step))
+            chunks[recv_idx] = _REDUCE_OPS[acc_op](
+                [chunks[recv_idx], np.asarray(incoming)])
+        owned = (self.rank + 1) % n
+        res = chunks[owned]
+        if mean:
+            res = res / n
+        # Rotate so the API's slots[rank] convention holds: ring ownership
+        # is chunk (rank+1)%n; the contract gives rank its OWN index.
+        self._send((self.rank + 1) % n, (seq, "rsrot", 0), res)
+        return np.asarray(self._recv((seq, "rsrot", 0)))
+
+    def _allgather(self, seq: int, value) -> List[np.ndarray]:
+        n = self.world_size
+        out: List[Optional[np.ndarray]] = [None] * n
+        out[self.rank] = np.asarray(value)
+        if n == 1:
+            return out  # type: ignore[return-value]
+        nxt = (self.rank + 1) % n
+        carry_idx = self.rank
+        for step in range(n - 1):
+            self._send(nxt, (seq, "ag", step), out[carry_idx])
+            carry_idx = (self.rank - step - 1) % n
+            out[carry_idx] = np.asarray(self._recv((seq, "ag", step)))
+        return out  # type: ignore[return-value]
+
+    def _broadcast(self, seq: int, value, src: int):
+        """Binomial tree: log2(N) rounds, no rank sends more than
+        ceil(log2 N) copies (vs the hub serializing N sends)."""
+        n = self.world_size
+        rel = (self.rank - src) % n
+        if rel != 0:
+            arr = np.asarray(self._recv((seq, "bc", rel)))
+        else:
+            arr = np.asarray(value)
+        # Forward to children in the binomial tree over RELATIVE ranks:
+        # node `rel` owns children rel + 2^k for 2^k > rel.
+        k = 1
+        while k < n:
+            if rel < k and rel + k < n:
+                child_rel = rel + k
+                self._send((src + child_rel) % n, (seq, "bc", child_rel), arr)
+            k *= 2
+        return arr
+
+    def _alltoall(self, seq: int, value):
+        n = self.world_size
+        shards = np.array_split(np.asarray(value), n, axis=0)
+        for dst in range(n):
+            if dst != self.rank:
+                self._send(dst, (seq, "a2a", self.rank), shards[dst])
+        pieces = []
+        for s in range(n):
+            if s == self.rank:
+                pieces.append(shards[self.rank])
+            else:
+                pieces.append(np.asarray(self._recv((seq, "a2a", s))))
+        return np.concatenate(pieces, axis=0)
+
+    # -- p2p ----------------------------------------------------------------
 
     def p2p_send(self, src: int, dst: int, value) -> None:
-        if self._hub is not None:
-            self._hub.p2p_send(src, dst, value)
-        else:
-            self._client.call("p2p_send", src, dst, value, timeout=60.0)
+        self._send(dst, ("p2p", src, dst,
+                         self._p2p_counter(src, dst, "send")), value)
 
-    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
-        if self._hub is not None:
-            return self._hub.p2p_recv(src, dst, timeout)
-        return self._client.call("p2p_recv", src, dst, timeout, timeout=None)
+    def p2p_recv(self, src: int, dst: int,
+                 timeout: Optional[float] = 60.0):
+        # Matching monotone counters on both ends keep repeated send/recv
+        # pairs FIFO-ordered. The recv counter commits only on SUCCESS: a
+        # timed-out recv must leave the cursor on the same tag so a retry
+        # consumes the late-arriving message instead of desyncing forever.
+        key = ("p2p_ctr", src, dst, "recv")
+        with self._op_lock:
+            d = getattr(self, "_p2p_counts", None)
+            if d is None:
+                d = self._p2p_counts = {}
+            nxt = d.get(key, 0) + 1
+        value = self._recv(("p2p", src, dst, nxt), timeout)
+        with self._op_lock:
+            self._p2p_counts[key] = nxt
+        return value
+
+    def _p2p_counter(self, src: int, dst: int, direction: str) -> int:
+        key = ("p2p_ctr", src, dst, direction)
+        with self._op_lock:
+            d = getattr(self, "_p2p_counts", None)
+            if d is None:
+                d = self._p2p_counts = {}
+            d[key] = d.get(key, 0) + 1
+            return d[key]
 
 
 @dataclass
@@ -238,9 +429,20 @@ def init_collective_group(
     rendezvouses through the process-wide registry (the analog of NCCL
     unique-id exchange via the reference's internal KV).
     """
-    if backend not in ("local", "gloo", "xla"):
+    if backend not in ("local", "gloo", "ring", "xla"):
         raise ValueError(f"unknown backend {backend}")
-    if backend == "gloo":
+    if backend == "xla":
+        # No silent fallback: eager DEVICE collectives require a live
+        # jax.distributed world (multi-host ICI/DCN), which this runtime
+        # wires through the mesh/Train layer, not the eager API. Anything
+        # else would quietly run host-side and misreport performance.
+        raise RuntimeError(
+            "backend='xla' is the compiled path: device tensors inside "
+            "jit'ed programs already use XLA collectives over ICI via "
+            "jax.sharding (see ray_tpu.parallel.mesh / JaxTrainer). For "
+            "eager host-tensor collectives between actors use "
+            "backend='gloo' (ring over sockets) or 'local' (in-process).")
+    if backend in ("gloo", "ring"):
         _init_distributed_group(world_size, rank, group_name)
     else:
         with _groups_lock:
@@ -264,42 +466,48 @@ def init_collective_group(
 
 
 def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None:
-    """Cross-process backend: rank 0 hosts the hub, its address rendezvouses
-    through the control plane's KV (exactly how the reference exchanges the
-    NCCL unique id — nccl_collective_group.py via the internal KV)."""
+    """Cross-process backend: every rank hosts a member mailbox server and
+    publishes its address through the control plane's KV (exactly how the
+    reference exchanges the NCCL unique id — nccl_collective_group.py via
+    the internal KV); collectives then run rank-to-rank over a ring /
+    binomial tree with no hub."""
     import time as _time
 
-    gcs = get_runtime().gcs
-    kv_key = f"collective:{group_name}:hub"
+    from ray_tpu.core.rpc import RpcServer
+
     with _groups_lock:
         existing = _groups.get(group_name)
         if existing is not None and existing.world_size != world_size:
             raise ValueError(
                 f"group {group_name} exists with world_size="
                 f"{existing.world_size}")
-    if rank == 0:
-        from ray_tpu.core.rpc import RpcServer
 
-        hub = _GroupHubService(world_size)
-        server = RpcServer(hub, name=f"collective-{group_name}",
-                           max_workers=max(8, world_size + 2))
-        gcs.kv_put(kv_key, server.address.encode(), namespace="collective")
-        group = _DistributedGroup(world_size, server.address, hub=hub)
-        group._server = server  # keep alive with the group
-        group._kv_key = kv_key
-    else:
-        deadline = _time.time() + 30.0
-        addr = None
-        while _time.time() < deadline:
-            raw = gcs.kv_get(kv_key, namespace="collective")
-            if raw:
-                addr = raw.decode()
-                break
+    gcs = get_runtime().gcs
+    service = _MemberService()
+    server = RpcServer(service, name=f"collective-{group_name}-r{rank}",
+                       max_workers=max(8, world_size + 2))
+    gcs.kv_put(f"collective:{group_name}:addr:{rank}",
+               server.address.encode(), namespace="collective")
+    addrs: List[Optional[str]] = [None] * world_size
+    addrs[rank] = server.address
+    deadline = _time.time() + 60.0
+    while any(a is None for a in addrs):
+        for r in range(world_size):
+            if addrs[r] is None:
+                raw = gcs.kv_get(f"collective:{group_name}:addr:{r}",
+                                 namespace="collective")
+                if raw:
+                    addrs[r] = raw.decode()
+        if any(a is None for a in addrs):
+            if _time.time() > deadline:
+                server.stop()
+                missing = [r for r in range(world_size) if addrs[r] is None]
+                raise TimeoutError(
+                    f"collective group {group_name}: ranks {missing} never "
+                    f"published their member address")
             _time.sleep(0.05)
-        if addr is None:
-            raise TimeoutError(
-                f"rank 0's hub address never appeared for group {group_name}")
-        group = _DistributedGroup(world_size, addr)
+    group = _DistributedGroup(world_size, rank, addrs, service, server)
+    group._kv_key = f"collective:{group_name}:addr:{rank}"
     with _groups_lock:
         _groups[group_name] = group  # type: ignore[assignment]
 
@@ -308,10 +516,13 @@ def destroy_collective_group(group_name: str = "default") -> None:
     with _groups_lock:
         state = _groups.pop(group_name, None)
     server = getattr(state, "_server", None)
-    if server is not None:  # rank 0 of a gloo group hosts the hub
+    if server is not None:  # cross-process member mailbox server
         server.stop()
+        peers = getattr(state, "_peers", None)
+        if peers is not None:  # close per-peer clients (one per rank)
+            peers.close_all()
         # Drop the rendezvous key so a re-created group can't race a
-        # later joiner onto the dead hub's address.
+        # later joiner onto a dead member's address.
         try:
             get_runtime().gcs.kv_del(getattr(state, "_kv_key", ""),
                                      namespace="collective")

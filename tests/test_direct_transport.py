@@ -313,3 +313,69 @@ def test_admit_in_order_pipelined_races():
     t13 = threading.Thread(target=admit2, args=(13, 13))
     t13.start(); t13.join(timeout=30)
     assert admitted == [10, 11, 13], admitted
+
+
+def test_admit_interior_gap_with_skip():
+    """An interior dropped seq (older calls still in flight) is closed by
+    the skip_actor_seq control message, not window_min."""
+    import threading
+
+    from ray_tpu.core.ids import ActorID, JobID, TaskID
+    from ray_tpu.core.task_spec import TaskOptions, TaskSpec, TaskType
+    from ray_tpu.core.worker_main import WorkerService, _ActorState
+
+    aid = ActorID.from_random()
+    state = _ActorState(aid, object(), max_concurrency=1)
+    svc = WorkerService.__new__(WorkerService)
+    svc._actors_lock = threading.Lock()
+    svc._actors = {aid: state}
+
+    def spec(seq, wm):
+        return TaskSpec(
+            task_id=TaskID.for_task(JobID.from_int(1), aid),
+            job_id=JobID.from_int(1), task_type=TaskType.ACTOR_TASK,
+            function_id="f", function_name="A", args=[], kwargs={},
+            options=TaskOptions(), actor_id=aid, actor_method="m",
+            sequence_number=seq, caller_id="h", window_min=wm)
+
+    admitted = []
+    lock = threading.Lock()
+
+    def admit(seq, wm):
+        svc._admit_in_order(state, spec(seq, wm), timeout=10.0)
+        with lock:
+            admitted.append(seq)
+
+    # seq 0 admitted; seq 1 in flight (slow); seq 2 dropped client-side;
+    # seq 3 sent with window_min=1 (1 still outstanding).
+    admit(0, 0)
+    t3 = threading.Thread(target=admit, args=(3, 1))
+    t3.start()
+    time.sleep(0.1)
+    svc.skip_actor_seq(aid.binary(), "h", 2)   # client reports the gap
+    t1 = threading.Thread(target=admit, args=(1, 1))
+    t1.start()
+    t1.join(timeout=30)
+    t3.join(timeout=30)
+    assert admitted == [0, 1, 3], admitted
+
+
+def test_unpicklable_actor_arg_does_not_wedge_handle(driver):
+    """A call with an unserializable argument fails cleanly AND later calls
+    on the same handle still run (interior-gap skip end-to-end)."""
+    import threading as _threading
+
+    @ray_tpu.remote
+    class Echo:
+        def val(self, x):
+            return x if not hasattr(x, "acquire") else "lock"
+
+    e = Echo.remote()
+    assert ray_tpu.get(e.val.remote(1), timeout=120) == 1
+    bad = e.val.remote(_threading.Lock())  # cannot pickle
+    with pytest.raises(Exception):
+        ray_tpu.get(bad, timeout=60)
+    # handle must not be wedged behind the dropped seq
+    assert ray_tpu.get(e.val.remote(2), timeout=60) == 2
+    assert ray_tpu.get([e.val.remote(i) for i in range(3, 8)],
+                       timeout=60) == list(range(3, 8))

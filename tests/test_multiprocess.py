@@ -774,3 +774,77 @@ def test_nested_tasks_no_deadlock_when_fully_leased():
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_ring_collectives_full_surface():
+    """The hubless ring backend across 4 actor processes: allreduce
+    (sum/mean/scalar), reducescatter, alltoall, broadcast from a nonzero
+    root, barrier, and back-to-back rounds (tag isolation between ops)."""
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 4})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            class Member:
+                def __init__(self, rank, world):
+                    from ray_tpu.parallel import collectives as c
+
+                    c.init_collective_group(world, rank, backend="gloo",
+                                            group_name="ring4")
+                    self.rank = rank
+                    self.world = world
+
+                def rounds(self):
+                    import numpy as np
+
+                    from ray_tpu.parallel import collectives as c
+
+                    out = {}
+                    base = np.arange(8.0) + self.rank
+                    out["sum"] = c.allreduce(base, group_name="ring4")
+                    out["mean"] = c.allreduce(base, op="mean",
+                                              group_name="ring4")
+                    out["scalar"] = c.allreduce(np.float64(self.rank + 1),
+                                                group_name="ring4")
+                    rs = c.reducescatter(np.arange(8.0) + self.rank,
+                                         group_name="ring4")
+                    out["rs"] = rs
+                    a2a = c.alltoall(np.arange(8.0) * (self.rank + 1),
+                                     group_name="ring4")
+                    out["a2a"] = a2a
+                    out["bcast"] = c.broadcast(
+                        np.array([9.0, 9.5]) if self.rank == 2 else None,
+                        src_rank=2, group_name="ring4")
+                    c.barrier(group_name="ring4")
+                    # second back-to-back allreduce: tags must not collide
+                    out["sum2"] = c.allreduce(np.ones(3) * self.rank,
+                                              group_name="ring4")
+                    return out
+
+            world = 4
+            members = [Member.options(num_cpus=1).remote(r, world)
+                       for r in range(world)]
+            results = ray_tpu.get([m.rounds.remote() for m in members],
+                                  timeout=240)
+            import numpy as np
+
+            expect_sum = np.sum([np.arange(8.0) + r for r in range(world)],
+                                axis=0)
+            for rank, out in enumerate(results):
+                np.testing.assert_allclose(out["sum"], expect_sum)
+                np.testing.assert_allclose(out["mean"], expect_sum / world)
+                assert out["scalar"] == sum(range(1, world + 1))
+                np.testing.assert_allclose(
+                    out["rs"], np.array_split(expect_sum, world)[rank])
+                expect_a2a = np.concatenate(
+                    [np.array_split(np.arange(8.0) * (s + 1), world)[rank]
+                     for s in range(world)])
+                np.testing.assert_allclose(out["a2a"], expect_a2a)
+                np.testing.assert_allclose(out["bcast"], [9.0, 9.5])
+                np.testing.assert_allclose(out["sum2"],
+                                           np.ones(3) * sum(range(world)))
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
