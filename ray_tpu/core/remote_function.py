@@ -74,6 +74,19 @@ def resolve_options(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Task
                 "DEFAULT": DefaultSchedulingStrategy(),
                 "SPREAD": SpreadSchedulingStrategy(),
             }[strategy]
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+        if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+                and isinstance(strategy.node_id, str)):
+            # Accept the hex form (what nodes()/the state API return): the
+            # scheduler keys nodes by NodeID, and an unnormalized string
+            # would silently never match — a hard affinity then queues
+            # forever instead of erroring.
+            from ray_tpu.core.ids import NodeID
+
+            strategy = NodeAffinitySchedulingStrategy(
+                node_id=NodeID.from_hex(strategy.node_id),
+                soft=strategy.soft)
         opts.scheduling_strategy = strategy
     return opts
 

@@ -6,9 +6,11 @@ HTTP ingress, autoscaling, batching.
 
 from ray_tpu.serve.api import (
     delete,
+    drain_proxy,
     get_deployment_handle,
     run,
     shutdown,
+    start_proxies,
     status,
 )
 from ray_tpu.serve.batching import batch

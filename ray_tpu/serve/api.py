@@ -82,6 +82,36 @@ def grpc_proxy_address() -> Optional[str]:
     return _grpc_proxy.address if _grpc_proxy is not None else None
 
 
+_proxy_manager = None
+
+
+def start_proxies(port: int = 0) -> Dict[str, str]:
+    """Start (or reconcile) per-node DETACHED proxy actors and return
+    node_id -> http address. Unlike the driver-thread proxy
+    (``_start_proxy=True``), these survive driver exit and support drain
+    (reference: serve/_private/proxy_state.py)."""
+    global _proxy_manager
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    get_or_create_controller()  # proxies resolve it by name
+    if _proxy_manager is None:
+        from ray_tpu.serve.proxy_state import ProxyManager
+
+        _proxy_manager = ProxyManager(CONTROLLER_NAME, port=port)
+    return _proxy_manager.sync()
+
+
+def drain_proxy(node_id: str, timeout_s: float = 30.0) -> bool:
+    """Drain + remove the proxy on one node (scale-down protocol). Works
+    from any driver: proxies are DETACHED named actors, so a driver that
+    didn't start them (or restarted) can still drain before scale-down."""
+    if _proxy_manager is not None:
+        return _proxy_manager.drain_node(node_id, timeout_s)
+    from ray_tpu.serve.proxy_state import ProxyManager
+
+    return ProxyManager.drain_detached(node_id, timeout_s)
+
+
 def _wait_ready(controller, names, timeout_s: float = 30.0) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -110,7 +140,13 @@ def delete(deployment_name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy, _grpc_proxy
+    global _proxy, _grpc_proxy, _proxy_manager
+    if _proxy_manager is not None:
+        try:
+            _proxy_manager.shutdown()
+        except Exception:
+            pass
+        _proxy_manager = None
     if _proxy is not None:
         _proxy.stop()
         _proxy = None

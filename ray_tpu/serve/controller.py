@@ -48,6 +48,19 @@ class ServeControllerActor:
         # deployment -> {replica key -> loaded multiplexed model ids}
         self._model_ids: Dict[str, Dict[str, list]] = {}
         self._model_poll_tick = 0
+        # Rolling updates: old-version replicas keep serving until the new
+        # version is fully up, then retire here — excluded from routing,
+        # killed only once drained (or past the grace cap). Entries are
+        # (replica, since, pending get_metrics ref or None).
+        self._retiring: Dict[str, List[Any]] = {}
+        # Serializes the reconcile body: actor calls (deploy/delete) and
+        # the background loop both reconcile; unsynchronized passes would
+        # double-spawn replicas or clobber _retiring.
+        self._reconcile_lock = threading.Lock()
+        # Replicas confirmed ready (answered check_health); rollouts only
+        # retire the old version once every NEW replica is ready.
+        self._ready: set = set()
+        self._ready_probes: Dict[str, Any] = {}  # actor id -> in-flight ref
         self._reconcile_thread = threading.Thread(target=self._loop, daemon=True)
         self._reconcile_thread.start()
 
@@ -99,7 +112,21 @@ class ServeControllerActor:
         self._running = False
         with self._lock:
             self._targets.clear()
-        self._reconcile_once()
+        # The reconcile thread is exiting: kill every replica NOW (graceful
+        # draining is for rollouts, not controller teardown) — parking them
+        # in _retiring here would leak them forever.
+        with self._reconcile_lock:
+            victims = [r for reps in self._replicas.values()
+                       for _v, r in reps]
+            victims += [r for lst in self._retiring.values()
+                        for r, _since, _ref in lst]
+            self._replicas.clear()
+            self._retiring.clear()
+        for r in victims:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
         return True
 
     # -- long poll (reference: long_poll.py LongPollHost) --------------------
@@ -109,18 +136,21 @@ class ServeControllerActor:
         while self._version == known_version and time.monotonic() < deadline:
             time.sleep(0.005)
         with self._lock:
-            table = {
-                name: {
-                    "replicas": [
-                        r for v, r in self._replicas.get(name, []) if v == t.version
-                    ],
+            table = {}
+            for name, t in self._targets.items():
+                reps = [r for v, r in self._replicas.get(name, [])
+                        if v == t.version]
+                if not reps:
+                    # Mid-rollout window: keep routing to the outgoing
+                    # version rather than publishing an empty replica set.
+                    reps = [r for _v, r in self._replicas.get(name, [])]
+                table[name] = {
+                    "replicas": reps,
                     "max_ongoing_requests": t.config.max_ongoing_requests,
                     "route_prefix": t.route_prefix,
                     # model-aware routing (pow_2_scheduler.py:127-135)
                     "model_ids": dict(self._model_ids.get(name, {})),
                 }
-                for name, t in self._targets.items()
-            }
             return self._version, table
 
     # -- metrics / autoscaling ----------------------------------------------
@@ -200,24 +230,31 @@ class ServeControllerActor:
                 with self._lock:
                     t.target_replicas = desired
 
+    # How long a retiring replica may linger past the router-snapshot age
+    # while finishing in-flight requests before it is force-killed.
+    RETIRE_GRACE_MAX_S = 15.0
+    # Minimum retirement age: at least one router snapshot refresh must
+    # elapse so no router is still picking the retiree when it exits.
+    RETIRE_MIN_S = 1.5
+
     def _reconcile_once(self):
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
         with self._lock:
             targets = dict(self._targets)
         changed = False
-        # scale up/down existing deployments
+        # scale up/down existing deployments — ROLLING on redeploy: the new
+        # version spins up to full strength AND turns ready while the old
+        # one keeps serving; old replicas then retire (unrouted, drained)
+        # rather than being killed under live requests
+        # (deployment_state.py's rolling update).
         for name, t in targets.items():
             current = self._replicas.setdefault(name, [])
-            # cull replicas from an older deploy version (redeploy)
+            fresh = [(v, r) for v, r in current if v == t.version]
             stale = [(v, r) for v, r in current if v != t.version]
-            if stale:
-                for _, r in stale:
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
-                current[:] = [(v, r) for v, r in current if v == t.version]
-                changed = True
-            while len(current) < t.target_replicas:
+            while len(fresh) < t.target_replicas:
                 opts = dict(t.config.ray_actor_options)
                 actor_opts: Dict[str, Any] = {}
                 if "num_cpus" in opts:
@@ -234,33 +271,107 @@ class ServeControllerActor:
                     t.init_kwargs,
                     t.config.user_config,
                 )
-                current.append((t.version, replica))
+                fresh.append((t.version, replica))
                 changed = True
-            while len(current) > t.target_replicas:
-                _, victim = current.pop()
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
+            while len(fresh) > t.target_replicas:
+                _, victim = fresh.pop()
+                self._retiring.setdefault(name, []).append(
+                    (victim, time.monotonic(), None))
                 changed = True
-        # drop deleted deployments
+            if stale and self._all_ready(r for _v, r in fresh):
+                # New version fully up AND ready (answered check_health):
+                # stop routing to the old one (the snapshot lists
+                # current-version replicas) and drain it. Until then the
+                # old version keeps serving — no availability stall while
+                # slow replica __init__s run.
+                self._retiring.setdefault(name, []).extend(
+                    (r, time.monotonic(), None) for _, r in stale)
+                stale = []
+                changed = True
+            current[:] = fresh + stale
+        # drop deleted deployments (their replicas drain too)
         for name in list(self._replicas):
             if name not in targets:
-                for _, r in self._replicas.pop(name):
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
+                self._retiring.setdefault(name, []).extend(
+                    (r, time.monotonic(), None)
+                    for _, r in self._replicas.pop(name))
                 changed = True
+        self._collect_retired()
         if changed:
             with self._lock:
                 self._version += 1
 
+    def _all_ready(self, replicas) -> bool:
+        """Non-blocking readiness: fire one check_health per replica, then
+        harvest on later ticks — the reconcile loop must never block on a
+        slow replica __init__."""
+        all_ready = True
+        for r in replicas:
+            key = r.actor_id.hex()
+            if key in self._ready:
+                continue
+            ref = self._ready_probes.get(key)
+            if ref is None:
+                self._ready_probes[key] = r.check_health.remote()
+                all_ready = False
+                continue
+            done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not done:
+                all_ready = False
+                continue
+            self._ready_probes.pop(key, None)
+            try:
+                ray_tpu.get(ref, timeout=1.0)
+                self._ready.add(key)
+            except Exception:  # noqa: BLE001 — probe again next tick
+                all_ready = False
+        if len(self._ready) > 4096:  # dead replicas' entries
+            self._ready.clear()
+        return all_ready
+
+    def _collect_retired(self):
+        now = time.monotonic()
+        for name in list(self._retiring):
+            keep = []
+            for replica, since, probe in self._retiring[name]:
+                age = now - since
+                done = age > self.RETIRE_GRACE_MAX_S
+                if not done and age > self.RETIRE_MIN_S:
+                    # Async drain probe: fire get_metrics, harvest next
+                    # tick — never block the reconcile loop on a busy
+                    # replica.
+                    if probe is None:
+                        probe = replica.get_metrics.remote()
+                    else:
+                        ready, _ = ray_tpu.wait([probe], num_returns=1,
+                                                timeout=0)
+                        if ready:
+                            try:
+                                metrics = ray_tpu.get(probe, timeout=1.0)
+                                done = metrics.get("ongoing", 0) <= 0
+                            except Exception:  # noqa: BLE001 — dead
+                                done = True
+                            probe = None
+                if done:
+                    try:
+                        ray_tpu.kill(replica)
+                    except Exception:
+                        pass
+                else:
+                    keep.append((replica, since, probe))
+            if keep:
+                self._retiring[name] = keep
+            else:
+                self._retiring.pop(name, None)
+
 
 def get_or_create_controller():
-    """Singleton via named actor (reference: serve's detached controller)."""
+    """Singleton via named DETACHED actor (reference: serve's detached
+    controller) — the control plane, like the per-node proxy actors,
+    outlives the driver that created it (serve.shutdown() kills it)."""
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         cls = ray_tpu.remote(ServeControllerActor)
-        return cls.options(name=CONTROLLER_NAME, num_cpus=0).remote()
+        return cls.options(name=CONTROLLER_NAME, num_cpus=0,
+                           lifetime="detached").remote()

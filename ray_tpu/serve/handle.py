@@ -21,19 +21,45 @@ import ray_tpu
 
 class DeploymentResponse:
     """Future-like response (reference: ``serve/handle.py
-    DeploymentResponse``)."""
+    DeploymentResponse``).
 
-    def __init__(self, ref, router: "Router", replica_key: str):
+    ``resubmit`` (router + call snapshot) lets ``result()`` transparently
+    retry on a DIFFERENT replica when the chosen one died before answering
+    (rolling redeploys, scale-downs, node loss) — the reference's router
+    retries replica-unavailable the same way."""
+
+    MAX_REPLICA_RETRIES = 3
+
+    def __init__(self, ref, router: "Router", replica_key: str,
+                 resubmit=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
+        self._resubmit = resubmit
         self._done = False
 
     def result(self, timeout_s: Optional[float] = None):
-        try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
-        finally:
-            self._finish()
+        from ray_tpu.core.exceptions import ActorError
+
+        attempts = 0
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
+            except ActorError:
+                self._finish()
+                attempts += 1
+                if self._resubmit is None or attempts > self.MAX_REPLICA_RETRIES:
+                    raise
+                self._ref, self._replica_key = self._resubmit()
+                self._done = False
+            except BaseException:
+                # User exceptions / timeouts are NOT retried, but the
+                # router's ongoing slot must still be released.
+                self._finish()
+                raise
+            else:
+                self._finish()
+                return value
 
     def _finish(self):
         if not self._done:
@@ -193,7 +219,12 @@ class DeploymentHandle:
             ).remote(self._method, *args, **kwargs)
             return DeploymentResponseGenerator(gen, self._router, key)
         ref = replica.handle_request.remote(self._method, *args, **kwargs)
-        return DeploymentResponse(ref, self._router, key)
+
+        def resubmit(method=self._method, a=args, kw=kwargs, mid=model_id):
+            rep, k = self._router._pick(mid)
+            return rep.handle_request.remote(method, *a, **kw), k
+
+        return DeploymentResponse(ref, self._router, key, resubmit=resubmit)
 
     def _push_metrics(self):
         """Reference: ``replica.py:214 _push_autoscaling_metrics`` (pushed

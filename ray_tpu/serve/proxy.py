@@ -24,13 +24,20 @@ class HttpProxy:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
         self._controller = controller
         self.host = host
-        self.port = port
+        self.port = port          # 0 = ephemeral; see bound_port after start
+        self.bound_port: Optional[int] = None
         self._handles: Dict[str, DeploymentHandle] = {}
         self._routes: Dict[str, str] = {}  # prefix -> deployment name
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._runner = None
+        # Drain protocol (reference: serve/_private/proxy_state.py): a
+        # draining proxy rejects NEW requests (503 + Connection: close) but
+        # lets in-flight ones finish before it reports drained.
+        self._draining = False
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -45,6 +52,21 @@ class HttpProxy:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    @property
+    def num_in_flight(self) -> int:
+        with self._in_flight_lock:
+            return self._in_flight
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop accepting new requests; True once no request is in flight."""
+        self._draining = True
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.num_in_flight == 0:
+                return True
+            time.sleep(0.02)
+        return self.num_in_flight == 0
+
     def _serve_forever(self) -> None:
         from aiohttp import web
 
@@ -58,6 +80,8 @@ class HttpProxy:
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         loop.run_until_complete(site.start())
+        socks = getattr(site._server, "sockets", None)
+        self.bound_port = socks[0].getsockname()[1] if socks else self.port
         self._runner = runner
         self._started.set()
         try:
@@ -84,6 +108,21 @@ class HttpProxy:
         return best[1] if best else None
 
     async def _handle(self, request):
+        from aiohttp import web
+
+        if self._draining:
+            return web.Response(
+                status=503, text="proxy draining",
+                headers={"Connection": "close"})
+        with self._in_flight_lock:
+            self._in_flight += 1
+        try:
+            return await self._handle_inner(request)
+        finally:
+            with self._in_flight_lock:
+                self._in_flight -= 1
+
+    async def _handle_inner(self, request):
         from aiohttp import web
 
         self._refresh_routes()

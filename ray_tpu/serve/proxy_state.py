@@ -1,0 +1,135 @@
+"""Per-node ingress proxy actors + drain lifecycle.
+
+Analog of the reference's ``python/ray/serve/_private/proxy_state.py``: the
+ingress data plane runs as PLACED, DETACHED actors (one per target node),
+not a thread of the driver — HTTP availability survives driver exit, and
+scale-down drains a proxy (reject new, finish in-flight) before removal.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+PROXY_NAME_PREFIX = "SERVE_PROXY"
+
+
+class _ProxyActorImpl:
+    """Hosts one HttpProxy inside a cluster worker process."""
+
+    def __init__(self, controller_name: str, port: int = 0):
+        from ray_tpu.serve.proxy import HttpProxy
+
+        controller = ray_tpu.get_actor(controller_name)
+        self._proxy = HttpProxy(controller, port=port)
+        self._proxy.start()
+
+    def address(self) -> str:
+        # The proxy binds this host; report the interface clients reach the
+        # node on (loopback clusters stay loopback).
+        host = self._proxy.host
+        return f"{host}:{self._proxy.bound_port}"
+
+    def ready(self) -> bool:
+        return self._proxy.bound_port is not None
+
+    def num_in_flight(self) -> int:
+        return self._proxy.num_in_flight
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        return self._proxy.drain(timeout_s)
+
+    def stop(self) -> bool:
+        self._proxy.stop()
+        return True
+
+
+class ProxyManager:
+    """Driver/controller-side view of the proxy fleet.
+
+    ``sync()`` reconciles: one proxy actor per alive node (node-affinity
+    placed, detached so it outlives the driver); ``drain_node()`` runs the
+    scale-down protocol: drain (reject new, finish in-flight) → stop →
+    kill.
+    """
+
+    def __init__(self, controller_name: str, port: int = 0):
+        self._controller_name = controller_name
+        self._port = port
+        self._proxies: Dict[str, object] = {}   # node_id -> actor handle
+        self._addresses: Dict[str, str] = {}
+
+    def sync(self) -> Dict[str, str]:
+        """Ensure a proxy on every alive node; returns node_id -> addr."""
+        alive = {n["NodeID"]: n for n in ray_tpu.nodes() if n.get("Alive")}
+        proxy_cls = ray_tpu.remote(_ProxyActorImpl)
+        for node_id in alive:
+            if node_id in self._proxies:
+                continue
+            name = f"{PROXY_NAME_PREFIX}::{node_id[:12]}"
+            try:
+                handle = ray_tpu.get_actor(name)
+            except Exception:  # noqa: BLE001 — not running yet
+                handle = proxy_cls.options(
+                    name=name,
+                    num_cpus=0,
+                    lifetime="detached",
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=node_id),
+                ).remote(self._controller_name, self._port)
+            ray_tpu.get(handle.ready.remote(), timeout=60)
+            self._proxies[node_id] = handle
+            self._addresses[node_id] = ray_tpu.get(handle.address.remote(),
+                                                   timeout=30)
+        for node_id in list(self._proxies):
+            if node_id not in alive:
+                self._proxies.pop(node_id, None)
+                self._addresses.pop(node_id, None)
+        return dict(self._addresses)
+
+    def addresses(self) -> Dict[str, str]:
+        return dict(self._addresses)
+
+    def drain_node(self, node_id: str, timeout_s: float = 30.0) -> bool:
+        """Scale-down: no new requests, in-flight finish, then the proxy
+        exits. True iff fully drained within the timeout."""
+        handle = self._proxies.pop(node_id, None)
+        self._addresses.pop(node_id, None)
+        if handle is None:
+            return True
+        drained = ray_tpu.get(handle.drain.remote(timeout_s),
+                              timeout=timeout_s + 30)
+        try:
+            ray_tpu.get(handle.stop.remote(), timeout=30)
+        finally:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        return bool(drained)
+
+    def shutdown(self) -> None:
+        for node_id in list(self._proxies):
+            self.drain_node(node_id, timeout_s=5.0)
+
+    @staticmethod
+    def drain_detached(node_id: str, timeout_s: float = 30.0) -> bool:
+        """Drain a proxy THIS process didn't start: resolve the detached
+        actor by its well-known name. True if drained or not running."""
+        name = f"{PROXY_NAME_PREFIX}::{node_id[:12]}"
+        try:
+            handle = ray_tpu.get_actor(name)
+        except Exception:  # noqa: BLE001 — no proxy on that node
+            return True
+        drained = ray_tpu.get(handle.drain.remote(timeout_s),
+                              timeout=timeout_s + 30)
+        try:
+            ray_tpu.get(handle.stop.remote(), timeout=30)
+        finally:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        return bool(drained)

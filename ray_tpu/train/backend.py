@@ -88,6 +88,16 @@ def _setup_jax_worker(coordinator: str, num_processes: int, process_id: int, ena
         os.environ["JAX_PROCESS_ID"] = str(process_id)
         import jax
 
+        # Elastic restart: a surviving (pooled) worker process may still
+        # hold the PREVIOUS incarnation's distributed client — XLA's
+        # coordination service assumes a fixed world, so the reference
+        # restarts the whole group (SURVEY hard-part #4); the process-level
+        # equivalent is shutdown-then-initialize against the new
+        # coordinator.
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — not initialized / already down
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,

@@ -157,6 +157,7 @@ class BackendExecutor:
             sc.num_workers,
             resources_per_worker=sc.worker_resources(),
             placement_strategy=sc.placement_strategy,
+            runtime_env=sc.runtime_env,
         )
         self.backend.on_start(self.worker_group, self.backend_config)
 
@@ -228,17 +229,33 @@ class BackendExecutor:
                 raise TrainingFailedError(f"worker(s) failed: {errors}")
             if len(finished) >= (self.worker_group.num_workers if self.worker_group else 0):
                 return None
+            # A worker PROCESS that died (kill -9, OOM, node loss) never
+            # reaches the collector's finish() — its run ref resolves to an
+            # ActorError instead. Without this probe the round barrier
+            # blocks forever on a dead rank (the reference's BackendExecutor
+            # polls worker health the same way, backend_executor.py:121).
+            self._raise_if_worker_died()
             if deadline and time.monotonic() > deadline:
                 raise TimeoutError("timed out waiting for training results")
             time.sleep(0.01)
 
-    def _maybe_raise_worker_errors(self):
-        done, _ = ray_tpu.wait(self._run_refs, num_returns=len(self._run_refs), timeout=5)
+    def _raise_if_worker_died(self) -> None:
+        self._probe_run_refs(wait_timeout=0)
+
+    def _probe_run_refs(self, wait_timeout: float) -> None:
+        """Raise TrainingFailedError if any completed run ref errored."""
+        done, _ = ray_tpu.wait(self._run_refs,
+                               num_returns=len(self._run_refs),
+                               timeout=wait_timeout)
         for ref in done:
             try:
-                ray_tpu.get(ref)
-            except Exception as e:  # re-raised remote error of any type
-                raise TrainingFailedError(str(e)) from e
+                ray_tpu.get(ref, timeout=5)
+            except Exception as e:  # noqa: BLE001 — actor/worker death
+                raise TrainingFailedError(
+                    f"train worker died mid-round: {e}") from e
+
+    def _maybe_raise_worker_errors(self):
+        self._probe_run_refs(wait_timeout=5)
 
     def finish_training(self) -> List[Any]:
         return ray_tpu.get(self._run_refs)

@@ -27,6 +27,9 @@ class ScalingConfig:
     # TPU-native extension: claim a whole slice per worker through its
     # head resource (one worker process per host, jax.distributed world).
     topology: Optional[str] = None  # e.g. "v5e-16"
+    # Per-worker runtime environment (env_vars apply at process SPAWN —
+    # needed for JAX device/platform config that must precede any import).
+    runtime_env: Optional[Dict[str, Any]] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

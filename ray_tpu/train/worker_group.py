@@ -58,6 +58,7 @@ class WorkerGroup:
         resources_per_worker: Optional[Dict[str, float]] = None,
         placement_strategy: str = "PACK",
         max_restarts: int = 0,
+        runtime_env: Optional[Dict[str, Any]] = None,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = dict(resources_per_worker or {"CPU": 1.0})
@@ -67,12 +68,16 @@ class WorkerGroup:
         )
         self._pg.wait()
         worker_cls = ray_tpu.remote(**{"max_restarts": max_restarts})(_TrainWorkerImpl)
+        extra: Dict[str, Any] = {}
+        if runtime_env:
+            extra["runtime_env"] = runtime_env
         self.workers = [
             worker_cls.options(
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self._pg, placement_group_bundle_index=i
                 ),
                 **self._resource_options(),
+                **extra,
             ).remote(i)
             for i in range(num_workers)
         ]

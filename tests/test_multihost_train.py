@@ -11,6 +11,7 @@ gradient psum crossed processes correctly (step 2's loss depends on step
 1's update).
 """
 
+import os
 import sys
 
 import cloudpickle
@@ -182,6 +183,162 @@ def test_two_process_global_mesh_matches_oracle():
             # ...and it matches the single-process oracle across BOTH steps
             # (step 2 proves the cross-process gradient psum was applied).
             np.testing.assert_allclose(losses[0], oracle, rtol=2e-4, atol=2e-4)
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Elastic fault tolerance (SURVEY hard-part #4; reference answer: whole-group
+# restart from the last checkpoint, backend_executor.py:121 + FailureConfig)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_loop(config):
+    """Deterministic 'training': loss halves each step. Rank 1 kills its own
+    PROCESS (kill -9 semantics: no cleanup, no finish() report) at step 3 of
+    the FIRST incarnation; the restarted group must resume from the last
+    checkpoint, not step 0."""
+    import os
+
+    from ray_tpu import train as rt_train
+
+    ctx = rt_train.get_context()
+    start_step, loss = 0, 64.0
+    ckpt = rt_train.get_checkpoint()
+    if ckpt is not None:
+        state = ckpt.to_dict()
+        start_step, loss = int(state["step"]) + 1, float(state["loss"])
+
+    marker = config["marker"]
+    for step in range(start_step, config["steps"]):
+        loss = loss / 2.0
+        if (ctx.get_world_rank() == 1 and step == 3
+                and not os.path.exists(marker)):
+            open(marker, "w").close()
+            os._exit(1)  # hard死 — simulates a host/process loss
+        rt_train.report(
+            {"step": step, "loss": loss, "rank": ctx.get_world_rank()},
+            checkpoint=(rt_train.Checkpoint.from_dict(
+                {"step": step, "loss": loss})
+                if ctx.get_world_rank() == 0 else None),
+        )
+
+
+def test_elastic_worker_death_restores_and_resumes(tmp_path):
+    """Kill one worker process mid-training: the BackendExecutor detects the
+    death (no hang on the round barrier), fit() tears the group down,
+    restarts it, restores the last checkpoint, and the loss trajectory
+    CONTINUES (values prove resume-from-checkpoint, not restart-from-0)."""
+    from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            marker = str(tmp_path / "killed-once")
+            trainer = JaxTrainer(
+                _elastic_loop,
+                train_loop_config={"steps": 6, "marker": marker},
+                scaling_config=ScalingConfig(num_workers=2,
+                                             cpus_per_worker=1),
+                run_config=RunConfig(
+                    name="elastic",
+                    storage_path=str(tmp_path / "results"),
+                    failure_config=FailureConfig(max_failures=2),
+                ),
+            )
+            result = trainer.fit()
+            assert result.error is None, result.error
+            losses = [m["loss"] for m in result.metrics_history]
+            # Deterministic halving from 64.0: a restart-from-scratch would
+            # repeat the early values; resume continues the series. The
+            # kill at step 3 may or may not lose step 2/3's report, so
+            # check: monotone halving, last value correct, and the series
+            # NEVER rewinds upward (which restart-from-0 would do).
+            assert losses[-1] == 64.0 / 2 ** 6, losses
+            assert all(b < a for a, b in zip(losses, losses[1:])), losses
+            assert os.path.exists(marker), "kill never happened"
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+
+
+def test_elastic_jax_distributed_world_reforms(tmp_path):
+    """After a worker-process death, the restarted group re-forms the
+    jax.distributed world (fresh coordinator, full device count) and a
+    cross-process psum still produces the right value — XLA's fixed-world
+    assumption handled by whole-group restart."""
+    from ray_tpu.train import (FailureConfig, JaxConfig, JaxTrainer,
+                               RunConfig, ScalingConfig)
+
+    def loop(config):
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train as rt_train
+
+        ctx = rt_train.get_context()
+        if (ctx.get_world_rank() == 1
+                and not os.path.exists(config["marker"])):
+            open(config["marker"], "w").close()
+            os._exit(1)
+        n_global = len(jax.devices())
+        # psum across the whole re-formed world
+        from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(data=-1), devices=jax.devices())
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = np.full((2,), float(ctx.get_world_rank() + 1))
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), local)
+        total = jax.jit(
+            lambda x: jax.numpy.sum(x),
+            out_shardings=NamedSharding(mesh, P()))(arr)
+        rt_train.report({"devices": n_global, "total": float(total),
+                         "incarnation": 2})
+
+    cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            marker = str(tmp_path / "jx-killed-once")
+            env_vars = {
+                "JAX_PLATFORMS": "cpu",
+                "JAX_NUM_CPU_DEVICES": "2",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "PALLAS_AXON_POOL_IPS": "",
+            }
+            trainer = JaxTrainer(
+                loop,
+                train_loop_config={"marker": marker},
+                backend_config=JaxConfig(init_distributed=True),
+                scaling_config=ScalingConfig(
+                    num_workers=2, cpus_per_worker=1,
+                    runtime_env={"env_vars": env_vars}),
+                run_config=RunConfig(
+                    name="elastic-jax",
+                    storage_path=str(tmp_path / "results"),
+                    failure_config=FailureConfig(max_failures=2),
+                ),
+            )
+            result = trainer.fit()
+            assert result.error is None, result.error
+            m = result.metrics
+            # world re-formed: 2 procs x 2 devices; psum over per-rank
+            # contributions (1+1) + (2+2) = 6
+            assert m["devices"] == 4, m
+            assert m["total"] == 6.0, m
+            assert os.path.exists(marker)
         finally:
             core.shutdown()
             runtime_mod._global_runtime = None
