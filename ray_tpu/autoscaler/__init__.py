@@ -1,6 +1,12 @@
-from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, bin_pack
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    GcsAutoscalerView,
+    bin_pack,
+)
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
+    LocalDaemonNodeProvider,
     NodeInstance,
     NodeProvider,
     NodeType,
@@ -10,10 +16,12 @@ from ray_tpu.autoscaler.node_provider import (
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
+    "GcsAutoscalerView",
     "bin_pack",
     "NodeProvider",
     "NodeType",
     "NodeInstance",
     "FakeNodeProvider",
+    "LocalDaemonNodeProvider",
     "TPUPodNodeProvider",
 ]

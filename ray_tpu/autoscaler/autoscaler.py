@@ -34,14 +34,19 @@ def _fits(resources: Dict[str, float], demand: Dict[str, float]) -> bool:
 
 
 def bin_pack(
-    demands: List[Dict[str, float]], node_types: List[NodeType], existing: Dict[str, int]
+    demands: List[Dict[str, float]], node_types: List[NodeType],
+    existing: Dict[str, int],
+    pending_capacity: Optional[List[Dict[str, float]]] = None,
 ) -> Dict[str, int]:
     """Choose node launches covering ``demands`` (reference:
     ``resource_demand_scheduler.py`` first-fit-decreasing). Returns
-    node_type -> count to launch, respecting max_workers."""
+    node_type -> count to launch, respecting max_workers.
+    ``pending_capacity``: resources of launches already in flight (cloud
+    nodes still booting) — credited against demand so a slow boot doesn't
+    trigger a duplicate VM on every reconcile tick."""
     to_launch: Dict[str, int] = {}
-    # virtual free capacity of planned launches
-    planned: List[Dict[str, float]] = []
+    # virtual free capacity of planned launches (incl. in-flight boots)
+    planned: List[Dict[str, float]] = [dict(c) for c in pending_capacity or ()]
     for demand in sorted(demands, key=lambda d: -sum(d.values())):
         placed = False
         for cap in planned:
@@ -67,6 +72,49 @@ def bin_pack(
         if not placed:
             logger.warning("demand %s unsatisfiable by any node type", demand)
     return to_launch
+
+
+class _NodeResourceView:
+    """Duck-types the scheduler's NodeResources for the busy check."""
+
+    class _Set:
+        def __init__(self, d):
+            self._d = dict(d)
+
+        def to_dict(self):
+            return dict(self._d)
+
+    def __init__(self, state: Dict):
+        self.total = self._Set(state["total"])
+        self.available = self._Set(state["available"])
+
+
+class GcsAutoscalerView:
+    """Runtime adapter for a MULTIPROCESS cluster: demand and per-node
+    resource state come from the GCS over RPC (the reference's
+    gcs_autoscaler_state_manager report), so the same Autoscaler loop
+    drives a live cluster of real daemon processes."""
+
+    def __init__(self, core=None):
+        from ray_tpu.core.runtime import get_runtime
+
+        self._core = core or get_runtime()
+        self.autoscaling_enabled = False
+        self.scheduler = self  # node_resources lives here
+
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        return self._core._gcs_rpc.call("pending_resource_demands",
+                                        timeout=30.0)
+
+    def retry_infeasible(self) -> None:
+        # Queued lease requests wake on the GCS scheduler CV when the new
+        # node registers — nothing to do driver-side.
+        return None
+
+    def node_resources(self, node_id):
+        state = self._core._gcs_rpc.call(
+            "node_resource_state", node_id.binary(), timeout=30.0)
+        return _NodeResourceView(state) if state else None
 
 
 class Autoscaler:
@@ -112,11 +160,17 @@ class Autoscaler:
     def update(self) -> None:
         demands = self.runtime.pending_resource_demands()
         existing: Dict[str, int] = {}
+        pending_capacity: List[Dict[str, float]] = []
         for inst in self.provider.non_terminated_nodes():
             existing[inst.node_type] = existing.get(inst.node_type, 0) + 1
+            if inst.status == "PENDING":
+                # Still booting: its capacity is on the way — count it so a
+                # slow cloud boot doesn't launch a duplicate every tick.
+                pending_capacity.append(dict(inst.resources))
 
         if demands:
-            launches = bin_pack(demands, list(self._types.values()), existing)
+            launches = bin_pack(demands, list(self._types.values()), existing,
+                                pending_capacity=pending_capacity)
             launched = 0
             for type_name, count in launches.items():
                 for _ in range(min(count, self.config.max_launch_batch)):

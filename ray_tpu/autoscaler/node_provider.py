@@ -89,42 +89,162 @@ class FakeNodeProvider(NodeProvider):
             return [i for i in self._instances.values() if i.status == "RUNNING"]
 
 
-class TPUPodNodeProvider(NodeProvider):
-    """GCE TPU-pod provider shape (reference: ``autoscaler/gcp/`` + TPU pod
-    handling). Actual GCE calls require credentials/egress; the command
-    surface is kept so a deployment can fill in ``_gcloud``."""
+class LocalDaemonNodeProvider(NodeProvider):
+    """Launches REAL node-daemon processes against a live multiprocess
+    cluster (the in-repo analog of the reference's load-bearing
+    ``_private/fake_multi_node`` provider): a scale-up is an actual
+    ``ray_tpu.core.node_daemon`` subprocess registering with the GCS; a
+    scale-down SIGTERMs it and the health check reaps the membership row."""
 
-    def __init__(self, project: str, zone: str, runtime_version: str = "tpu-ubuntu2204-base"):
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._instances: Dict[str, NodeInstance] = {}
+        self._procs: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: NodeType) -> NodeInstance:
+        import json
+        import subprocess
+        import sys
+
+        from ray_tpu.core.cluster import _read_tagged_line
+        from ray_tpu.core.ids import NodeID
+
+        labels = {"node-type": node_type.name, **node_type.labels}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_daemon",
+             "--gcs", self.gcs_address,
+             "--resources", json.dumps(dict(node_type.resources)),
+             "--labels", json.dumps(labels)],
+            stdout=subprocess.PIPE,
+        )
+        _read_tagged_line(proc, "NODE_ADDRESS")
+        node_id = NodeID.from_hex(_read_tagged_line(proc, "NODE_ID"))
+        _read_tagged_line(proc, "STORE_NAME")
+        inst = NodeInstance(
+            instance_id=f"daemon-{uuid.uuid4().hex[:8]}",
+            node_type=node_type.name,
+            resources=dict(node_type.resources),
+            node_id=node_id,
+        )
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+            self._procs[inst.instance_id] = proc
+        return inst
+
+    def terminate_node(self, instance: NodeInstance) -> None:
+        import signal as _signal
+
+        with self._lock:
+            inst = self._instances.pop(instance.instance_id, None)
+            proc = self._procs.pop(instance.instance_id, None)
+        if proc is not None:
+            try:
+                proc.send_signal(_signal.SIGTERM)
+                proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — already gone / stuck
+                proc.kill()
+        if inst is not None:
+            inst.status = "TERMINATED"
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if i.status == "RUNNING"]
+
+    def shutdown(self) -> None:
+        for inst in self.non_terminated_nodes():
+            self.terminate_node(inst)
+
+
+class TPUPodNodeProvider(NodeProvider):
+    """GCE TPU-VM provider (reference: ``autoscaler/gcp/`` + the TPU pod
+    handling in ``_private/accelerators/tpu.py``). All cloud interaction
+    funnels through an injectable ``runner(argv) -> str`` (default: the
+    real ``gcloud`` CLI via subprocess) so deployments swap in their
+    transport and tests mock it — no hidden egress."""
+
+    def __init__(self, project: str, zone: str,
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 runner: Optional[Any] = None):
         self.project = project
         self.zone = zone
         self.runtime_version = runtime_version
+        self._runner = runner or self._subprocess_runner
         self._instances: Dict[str, NodeInstance] = {}
+        self._last_poll: Dict[str, float] = {}  # describe rate limit
+        self._lock = threading.Lock()
 
-    def _gcloud(self, *args: str) -> str:  # pragma: no cover - needs egress
-        raise NotImplementedError(
-            "TPUPodNodeProvider requires GCE access; subclass and implement "
-            "_gcloud (e.g. `gcloud compute tpus tpu-vm ...`) for deployment"
-        )
+    # Minimum seconds between `describe` polls per booting instance — the
+    # reconcile loop runs at sub-second ticks and must not hammer the API.
+    POLL_INTERVAL_S = 10.0
 
-    def create_node(self, node_type: NodeType) -> NodeInstance:  # pragma: no cover
+    @staticmethod
+    def _subprocess_runner(argv: List[str]) -> str:  # pragma: no cover
+        import subprocess
+
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(argv[:6])}... failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    def _gcloud(self, *args: str) -> str:
+        return self._runner(["gcloud", *args, f"--project={self.project}"])
+
+    def create_node(self, node_type: NodeType) -> NodeInstance:
         accel = node_type.labels.get("tpu-accelerator-type", "v5litepod-4")
         name = f"rtpu-{uuid.uuid4().hex[:8]}"
         self._gcloud(
             "compute", "tpus", "tpu-vm", "create", name,
             f"--zone={self.zone}", f"--accelerator-type={accel}",
-            f"--version={self.runtime_version}",
+            f"--version={self.runtime_version}", "--format=json",
         )
         inst = NodeInstance(instance_id=name, node_type=node_type.name,
-                            resources=dict(node_type.resources))
-        self._instances[name] = inst
+                            resources=dict(node_type.resources),
+                            status="PENDING")
+        with self._lock:
+            self._instances[name] = inst
+        self._refresh_state(inst, force=True)
         return inst
 
-    def terminate_node(self, instance: NodeInstance) -> None:  # pragma: no cover
+    def _refresh_state(self, inst: NodeInstance, force: bool = False) -> None:
+        import json
+        import time as _time
+
+        now = _time.monotonic()
+        if not force and now - self._last_poll.get(inst.instance_id, 0.0) \
+                < self.POLL_INTERVAL_S:
+            return
+        self._last_poll[inst.instance_id] = now
+        try:
+            raw = self._gcloud(
+                "compute", "tpus", "tpu-vm", "describe", inst.instance_id,
+                f"--zone={self.zone}", "--format=json",
+            )
+            state = json.loads(raw).get("state", "")
+        except Exception:  # noqa: BLE001 — deleted / transient API error
+            return
+        if state == "READY":
+            inst.status = "RUNNING"
+        elif state in ("DELETING", "TERMINATED", "PREEMPTED"):
+            inst.status = "TERMINATED"
+
+    def terminate_node(self, instance: NodeInstance) -> None:
         self._gcloud(
             "compute", "tpus", "tpu-vm", "delete", instance.instance_id,
             f"--zone={self.zone}", "--quiet",
         )
-        self._instances.pop(instance.instance_id, None)
+        with self._lock:
+            inst = self._instances.pop(instance.instance_id, None)
+        if inst is not None:
+            inst.status = "TERMINATED"
 
     def non_terminated_nodes(self) -> List[NodeInstance]:
-        return [i for i in self._instances.values() if i.status == "RUNNING"]
+        with self._lock:
+            instances = list(self._instances.values())
+        for inst in instances:
+            if inst.status == "PENDING":
+                self._refresh_state(inst)
+        return [i for i in instances if i.status != "TERMINATED"]

@@ -95,6 +95,8 @@ class GcsService:
         self.scheduler = ClusterResourceScheduler()
         self._lock = threading.RLock()
         self._sched_cv = threading.Condition(self._lock)
+        self._waiting_demands: Dict[int, Dict[str, float]] = {}
+        self._demand_seq = 0
         self._node_addr: Dict[NodeID, str] = {}
         self._heartbeats: Dict[NodeID, float] = {}
         self._dead_nodes: set = set()  # explicitly declared dead
@@ -285,6 +287,24 @@ class GcsService:
             pg_id = pg.id if hasattr(pg, "id") else pg
             bundle_index = strategy.placement_group_bundle_index
         with self._lock:
+            # Register as pending demand while waiting: the autoscaler reads
+            # this to size the cluster (gcs_autoscaler_state_manager.cc's
+            # demand report). One request may re-enter the wait many times
+            # within its timeout slices — the id keys a single logical wait.
+            self._demand_seq += 1
+            demand_id = self._demand_seq
+            self._waiting_demands[demand_id] = dict(resources)
+        try:
+            return self._request_lease_wait(request, resources, strategy,
+                                            deadline, timeout, pg_id,
+                                            bundle_index, _client_id)
+        finally:
+            with self._lock:
+                self._waiting_demands.pop(demand_id, None)
+
+    def _request_lease_wait(self, request, resources, strategy, deadline,
+                            timeout, pg_id, bundle_index, _client_id):
+        with self._lock:
             while True:
                 if (isinstance(strategy, NodeAffinitySchedulingStrategy)
                         and not strategy.soft
@@ -329,6 +349,20 @@ class GcsService:
                 self._sched_cv.wait(timeout=min(remaining, 1.0))
 
     request_lease._rpc_wants_conn = True  # RpcServer injects _client_id
+
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Resource shapes of lease requests currently WAITING (queued or
+        infeasible) — what the autoscaler sizes the cluster against."""
+        with self._lock:
+            return list(self._waiting_demands.values())
+
+    def node_resource_state(self, node_id_bytes: bytes) -> Optional[dict]:
+        """Per-node {total, available} for the autoscaler's idle check."""
+        nr = self.scheduler.node_resources(NodeID(node_id_bytes))
+        if nr is None:
+            return None
+        return {"total": nr.total.to_dict(),
+                "available": nr.available.to_dict()}
 
     def _try_lease(self, request: ResourceSet, strategy,
                    client_id: str = "") -> Optional[Tuple[str, NodeID, str]]:

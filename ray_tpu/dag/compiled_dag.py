@@ -20,7 +20,7 @@ import threading
 from typing import Any, List, Optional
 
 from ray_tpu.core.task_spec import DAG_LOOP_METHOD
-from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.channel import Channel, ChannelClosed, SocketChannel
 from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
 
 
@@ -60,7 +60,13 @@ class DAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, leaf: DAGNode, *, channel_capacity: int = 4 * 1024 * 1024):
+    def __init__(self, leaf: DAGNode, *, channel_capacity: int = 4 * 1024 * 1024,
+                 channel_type: str = "auto"):
+        """``channel_type``: "shm" (same-host mutable shm), "socket"
+        (cross-host TCP), or "auto" — per EDGE, shm when both endpoints
+        share a host, sockets otherwise (the reference's aDAG channels are
+        likewise transport-selected per pair, experimental/channel.py:51).
+        """
         chain = leaf.chain()
         if not chain or not isinstance(chain[0], InputNode):
             raise ValueError("DAG must start from an InputNode")
@@ -77,9 +83,21 @@ class CompiledDAG:
                     "resident loop occupies an actor's execution thread, so "
                     "a second stage on the same actor can never start")
             seen_actors.add(aid)
-        # One channel per edge: input + one per stage output.
-        self._channels = [Channel(capacity=channel_capacity)
-                          for _ in range(len(stages) + 1)]
+        # One channel per edge: input + one per stage output. Edge i is
+        # written by stage i-1 (the driver for i=0) and read by stage i
+        # (the driver for the last).
+        hosts = self._endpoint_hosts(stages) if channel_type == "auto" else None
+        self._channels = []
+        for i in range(len(stages) + 1):
+            if channel_type == "socket":
+                cross = True
+            elif channel_type == "shm":
+                cross = False
+            else:
+                cross = hosts is not None and hosts[i] != hosts[i + 1]
+            self._channels.append(
+                SocketChannel(capacity=channel_capacity) if cross
+                else Channel(capacity=channel_capacity))
         self._loop_refs = []
         for i, stage in enumerate(stages):
             # Park the actor in its resident loop (a long-running actor task
@@ -108,6 +126,26 @@ class CompiledDAG:
         self._lock = threading.Lock()
         self._write_lock = threading.Lock()
         self._torn_down = False
+
+    @staticmethod
+    def _endpoint_hosts(stages) -> List[str]:
+        """Host of every channel endpoint: [driver, stage0, ..., stageN,
+        driver] collapsed to per-edge endpoints (len = stages + 2)."""
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+
+        def actor_host(actor) -> str:
+            try:
+                addr = rt._actor_address(actor.actor_id)
+                return addr.rsplit(":", 1)[0]
+            except Exception:  # noqa: BLE001 — in-process runtime
+                return "local"
+
+        driver_host = (rt.owner_address.rsplit(":", 1)[0]
+                       if hasattr(rt, "owner_address") else "local")
+        return ([driver_host] + [actor_host(s.actor) for s in stages]
+                + [driver_host])
 
     def execute(self, value: Any) -> DAGRef:
         """One DAG step: a single shm write; result via the returned ref.

@@ -269,21 +269,11 @@ class Dataset:
         batch_format: str = "numpy",
         drop_last: bool = False,
     ) -> Iterator[TUnion[Batch, pa.Table]]:
-        carry: Optional[pa.Table] = None
-        for block in self.iter_blocks():
-            if carry is not None and carry.num_rows:
-                block = BlockAccessor.concat([carry, block])
-                carry = None
-            acc = BlockAccessor(block)
-            n = acc.num_rows()
-            pos = 0
-            while n - pos >= batch_size:
-                yield _format_batch(acc.slice(pos, pos + batch_size), batch_format)
-                pos += batch_size
-            if pos < n:
-                carry = acc.slice(pos, n)
-        if carry is not None and carry.num_rows and not drop_last:
-            yield _format_batch(carry, batch_format)
+        from ray_tpu.data.iterator import batches_from_blocks
+
+        yield from batches_from_blocks(
+            self.iter_blocks(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last)
 
     def take(self, n: int = 20) -> List[Row]:
         out: List[Row] = []
@@ -315,10 +305,14 @@ class Dataset:
 
         return DataIterator(self)
 
-    def streaming_split(self, n: int, *, equal: bool = True):
-        from ray_tpu.data.iterator import DataIterator
+    def streaming_split(self, n: int, *, equal: bool = False):
+        """N iterators over ONE coordinated streaming execution with
+        DYNAMIC block assignment (work stealing) — not a static split
+        (reference: _internal/iterator/stream_split_iterator.py).
+        ``equal=True`` keeps consumers within one block of each other."""
+        from ray_tpu.data.stream_split import make_stream_split
 
-        return [DataIterator(shard) for shard in self.split(n, equal=equal)]
+        return make_stream_split(self._plan, n, equal)
 
     # ---------------------------------------------------------------- writes
     def write_parquet(self, path: str) -> None:

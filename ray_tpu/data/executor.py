@@ -30,6 +30,66 @@ from ray_tpu.data.plan import (
 )
 
 DEFAULT_MAX_IN_FLIGHT = 8
+# Default object-plane budget for one streaming execution (all stages
+# combined). The window of each stage adapts to measured block sizes so a
+# pipeline of 100MB blocks holds far fewer in flight than one of 100KB
+# blocks (reference: resource-budgeted operator scheduling,
+# streaming_executor_state.py:494 + backpressure_policy/).
+DEFAULT_MEMORY_BUDGET = 512 * 1024 * 1024
+
+
+class _MemoryBudget:
+    """Adaptive per-stage windows from a shared byte budget.
+
+    Block sizes are learned online: sealed blocks register their size in
+    the GCS object directory; inline-small blocks fall back to the running
+    estimate. Each stage's window = share of the remaining budget divided
+    by the size estimate, clamped to [1, max_in_flight]."""
+
+    def __init__(self, total_bytes: int, max_in_flight: int):
+        self.total = total_bytes
+        self.max_in_flight = max_in_flight
+        self._avg = 1 * 1024 * 1024  # prior: 1MB blocks
+        self._samples = 0
+        self.stages = 1
+
+    def note_block(self, ref) -> None:
+        # Size probes are a GCS RPC — sample the first blocks to learn the
+        # shape, then only every 32nd, so the estimate stays fresh without
+        # a control-plane round trip per block on the streaming hot path.
+        self._seen = getattr(self, "_seen", 0) + 1
+        if self._samples >= 8 and self._seen % 32 != 0:
+            return
+        size = _ref_size(ref)
+        if size is None or size <= 0:
+            return
+        self._samples += 1
+        alpha = max(0.1, 1.0 / self._samples)
+        self._avg = (1 - alpha) * self._avg + alpha * size
+
+    def window(self) -> int:
+        per_stage = self.total / max(1, self.stages)
+        return max(1, min(self.max_in_flight, int(per_stage // self._avg)))
+
+    @property
+    def avg_block_bytes(self) -> float:
+        return self._avg
+
+
+def _ref_size(ref) -> Optional[int]:
+    """Size of a sealed block from the object directory (None if the block
+    is inline-owned/unsealed — those are sub-100KiB by construction)."""
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        locations = rt._gcs_rpc.call("locate_object", ref.id.binary())
+        for _node, _addr, size in locations:
+            if size:
+                return int(size)
+    except Exception:  # noqa: BLE001 — in-process runtime / GCS miss
+        return None
+    return None
 
 
 def _run_read_task(task: Callable):
@@ -53,13 +113,34 @@ class _MapActorImpl:
 
 
 def execute_streaming(
-    plan: LogicalPlan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+    plan: LogicalPlan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+    memory_budget: int = DEFAULT_MEMORY_BUDGET,
+    _stats: Optional[Dict[str, Any]] = None,
 ) -> Iterator[Any]:
-    """Yield block refs as they become available."""
-    return _compile(plan.optimized().dag, max_in_flight)
+    """Yield block refs as they become available. ``memory_budget`` bounds
+    the object-plane bytes the whole pipeline holds in flight (adaptive
+    per-stage windows; ``max_in_flight`` is the hard task-count cap)."""
+    dag = plan.optimized().dag
+    budget = _MemoryBudget(memory_budget, max_in_flight)
+    budget.stages = _count_windowed_stages(dag)
+    if _stats is not None:
+        _stats["budget"] = budget
+        _stats.setdefault("max_pending", 0)
+    return _compile(dag, max_in_flight, budget, _stats)
 
 
-def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
+def _count_windowed_stages(op: LogicalOp) -> int:
+    n = 1 if isinstance(op, (Read, MapBlocks)) else 0
+    return n + sum(_count_windowed_stages(i) for i in op.inputs)
+
+
+def _note_pending(stats: Optional[Dict[str, Any]], n: int) -> None:
+    if stats is not None and n > stats.get("max_pending", 0):
+        stats["max_pending"] = n
+
+
+def _compile(op: LogicalOp, max_in_flight: int, budget: _MemoryBudget,
+             stats: Optional[Dict[str, Any]] = None) -> Iterator[Any]:
     if isinstance(op, InputData):
         return iter(list(op.block_refs))
     if isinstance(op, Read):
@@ -70,41 +151,47 @@ def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
             tasks = iter(op.read_tasks)
             exhausted = False
             while True:
-                while not exhausted and len(pending) < max_in_flight:
+                while not exhausted and len(pending) < budget.window():
                     t = next(tasks, None)
                     if t is None:
                         exhausted = True
                         break
                     pending.append(read_remote.remote(t))
+                _note_pending(stats, len(pending))
                 if not pending:
                     return
-                yield pending.popleft()
+                ref = pending.popleft()
+                budget.note_block(ref)
+                yield ref
 
         return gen_read()
     if isinstance(op, MapBlocks):
-        upstream = _compile(op.inputs[0], max_in_flight)
+        upstream = _compile(op.inputs[0], max_in_flight, budget, stats)
         if op.compute == "actors":
             return _actor_map(op, upstream, max_in_flight)
         map_remote = ray_tpu.remote(_apply_map).options(num_cpus=op.num_cpus)
-        cap = op.concurrency or max_in_flight
 
         def gen_map() -> Iterator[Any]:
             pending: deque = deque()
             exhausted = False
             while True:
+                cap = op.concurrency or budget.window()
                 while not exhausted and len(pending) < cap:
                     ref = next(upstream, None)
                     if ref is None:
                         exhausted = True
                         break
                     pending.append(map_remote.remote(op.fn, ref))
+                _note_pending(stats, len(pending))
                 if not pending:
                     return
-                yield pending.popleft()
+                ref = pending.popleft()
+                budget.note_block(ref)
+                yield ref
 
         return gen_map()
     if isinstance(op, AllToAll):
-        upstream = _compile(op.inputs[0], max_in_flight)
+        upstream = _compile(op.inputs[0], max_in_flight, budget, stats)
 
         def gen_barrier() -> Iterator[Any]:
             all_refs = list(upstream)
@@ -112,7 +199,8 @@ def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
 
         return gen_barrier()
     if isinstance(op, Union):
-        streams = [_compile(i, max_in_flight) for i in op.inputs]
+        streams = [_compile(i, max_in_flight, budget, stats)
+                   for i in op.inputs]
 
         def gen_union() -> Iterator[Any]:
             for s in streams:
@@ -120,7 +208,7 @@ def _compile(op: LogicalOp, max_in_flight: int) -> Iterator[Any]:
 
         return gen_union()
     if isinstance(op, Limit):
-        upstream = _compile(op.inputs[0], max_in_flight)
+        upstream = _compile(op.inputs[0], max_in_flight, budget, stats)
 
         def gen_limit() -> Iterator[Any]:
             from ray_tpu.data.block import BlockAccessor

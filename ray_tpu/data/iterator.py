@@ -14,15 +14,35 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import numpy as np
 
 
-class DataIterator:
-    def __init__(self, dataset):
-        self._ds = dataset
+def batches_from_blocks(blocks, *, batch_size: int,
+                        batch_format: str = "numpy",
+                        drop_last: bool = False) -> Iterator[Any]:
+    """Re-batch a stream of pyarrow blocks into fixed-size batches (the
+    carry/slice loop shared by Dataset.iter_batches and the coordinated
+    streaming-split iterators)."""
+    from ray_tpu.data.block import BlockAccessor
+    from ray_tpu.data.dataset import _format_batch
 
-    def iter_batches(self, **kw) -> Iterator[Dict[str, np.ndarray]]:
-        return self._ds.iter_batches(**kw)
+    carry = None
+    for block in blocks:
+        if carry is not None and carry.num_rows:
+            block = BlockAccessor.concat([carry, block])
+            carry = None
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        pos = 0
+        while n - pos >= batch_size:
+            yield _format_batch(acc.slice(pos, pos + batch_size),
+                                batch_format)
+            pos += batch_size
+        if pos < n:
+            carry = acc.slice(pos, n)
+    if carry is not None and carry.num_rows and not drop_last:
+        yield _format_batch(carry, batch_format)
 
-    def iter_rows(self):
-        return self._ds.iter_rows()
+
+class JaxBatchesMixin:
+    """``iter_jax_batches`` over any ``iter_batches`` implementation."""
 
     def iter_jax_batches(
         self,
@@ -37,7 +57,8 @@ class DataIterator:
         import jax
         import jax.numpy as jnp
 
-        for batch in self._ds.iter_batches(batch_size=batch_size, drop_last=drop_last):
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
             if collate_fn is not None:
                 yield collate_fn(batch)
                 continue
@@ -48,6 +69,17 @@ class DataIterator:
                     arr = jax.device_put(arr, sharding)
                 out[k] = arr
             yield out
+
+
+class DataIterator(JaxBatchesMixin):
+    def __init__(self, dataset):
+        self._ds = dataset
+
+    def iter_batches(self, **kw) -> Iterator[Dict[str, np.ndarray]]:
+        return self._ds.iter_batches(**kw)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
 
     def materialize(self):
         return self._ds.materialize()

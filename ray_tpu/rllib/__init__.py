@@ -5,6 +5,7 @@ Learner / LearnerGroup / EnvRunner; old Policy/RolloutWorker stack explicitly
 not ported — SURVEY §7 "do NOT port").
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
 from ray_tpu.rllib.impala import IMPALA, AggregatorActor, ImpalaConfig, ImpalaLearner, vtrace
@@ -30,4 +31,8 @@ __all__ = [
     "ImpalaLearner",
     "AggregatorActor",
     "vtrace",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
+    "ReplayBuffer",
 ]

@@ -1,0 +1,283 @@
+"""DQN — the off-policy value-learning family.
+
+Analog of the reference's ``rllib/algorithms/dqn/dqn.py`` on the new API
+stack: EnvRunner actors explore epsilon-greedily, transitions land in a
+uniform replay buffer, and the learner minimizes the Huber TD error
+against a periodically-synced TARGET network (Mnih et al. 2015; double-DQN
+action selection per van Hasselt 2016 is the default, as in the
+reference). TPU-native shape: the TD targets and the gradient step are
+two jitted programs; the target sync is a pytree copy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.algorithm_config import AlgorithmConfigBase
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import spec_for_env
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer replay (reference:
+    ``rllib/utils/replay_buffers/replay_buffer.py``)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add_batch(self, transitions: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(transitions.values())))
+        if not self._storage:
+            for k, v in transitions.items():
+                shape = (self.capacity,) + v.shape[1:]
+                self._storage[k] = np.zeros(shape, v.dtype)
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in transitions.items():
+            self._storage[k][idx] = v
+        self._next = int((self._next + n) % self.capacity)
+        self._size = min(self.capacity, self._size + n)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._storage.items()}
+
+    def __len__(self) -> int:
+        return self._size
+
+
+class DQNLearner(Learner):
+    """Huber TD loss vs a target network; the head's outputs ARE Q(s, .)."""
+
+    def __init__(self, spec, config: Dict[str, Any], seed: int = 0):
+        super().__init__(spec, config, seed=seed)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._updates = 0
+
+        def td_targets(target_params, online_params, next_obs, rewards,
+                       terminateds):
+            q_next_t = self.module.forward_train(
+                target_params, next_obs)["action_dist_inputs"]
+            if self.config.get("double_q", True):
+                # Double DQN: ONLINE net picks the argmax action, the
+                # TARGET net evaluates it (van Hasselt 2016).
+                q_next_o = self.module.forward_train(
+                    online_params, next_obs)["action_dist_inputs"]
+                best = jnp.argmax(q_next_o, axis=-1)
+                next_q = q_next_t[jnp.arange(q_next_t.shape[0]), best]
+            else:
+                next_q = jnp.max(q_next_t, axis=-1)
+            gamma = self.config.get("gamma", 0.99)
+            return rewards + gamma * (1.0 - terminateds) * next_q
+
+        self._targets_fn = jax.jit(td_targets)
+
+    def loss_fn(self, params, batch):
+        q = self.module.forward_train(params, batch["obs"])["action_dist_inputs"]
+        qa = q[jnp.arange(q.shape[0]), batch["actions"].astype(jnp.int32)]
+        err = qa - batch["targets"]
+        # Huber (delta=1): quadratic near 0, linear in the tails.
+        huber = jnp.where(jnp.abs(err) <= 1.0, 0.5 * err**2,
+                          jnp.abs(err) - 0.5)
+        return jnp.mean(huber)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        targets = self._targets_fn(
+            self.target_params, self.params,
+            jnp.asarray(batch["next_obs"]), jnp.asarray(batch["rewards"]),
+            jnp.asarray(batch["terminateds"]))
+        metrics = super().update({
+            "obs": batch["obs"],
+            "actions": batch["actions"],
+            "targets": np.asarray(targets),
+        })
+        self._updates += 1
+        if self._updates % self.config.get("target_update_freq", 100) == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return metrics
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state: Dict) -> bool:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree.map(jnp.asarray,
+                                              state["target_params"])
+            self._updates = int(state.get("updates", 0))
+        return True
+
+
+@dataclass
+class DQNConfig(AlgorithmConfigBase):
+    env: Optional[Callable[[], Any]] = None
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 32
+    buffer_capacity: int = 50_000
+    train_batch_size: int = 64
+    num_steps_sampled_before_learning: int = 500
+    updates_per_iteration: int = 32
+    target_update_freq: int = 100
+    gamma: float = 0.99
+    lr: float = 1e-3
+    grad_clip: float = 10.0
+    double_q: bool = True
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_timesteps: int = 5_000
+    seed: int = 0
+    hidden: Optional[tuple] = None
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Tune-compatible train() contract (reference: dqn.py training_step)."""
+
+    def __init__(self, config: DQNConfig):
+        assert config.env is not None, "config.environment(env_creator) required"
+        self.config = config
+        probe = config.env()
+        self.spec = spec_for_env(probe)
+        probe.close()
+        assert self.spec.discrete, "DQN requires a discrete action space"
+        if config.hidden and not self.spec.conv:
+            import dataclasses
+
+            self.spec = dataclasses.replace(self.spec,
+                                            hidden=tuple(config.hidden))
+
+        self.learner = DQNLearner(self.spec, {
+            "lr": config.lr, "gamma": config.gamma,
+            "grad_clip": config.grad_clip, "double_q": config.double_q,
+            "target_update_freq": config.target_update_freq,
+        }, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            runner_cls.remote(
+                config.env, num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, spec=self.spec,
+            )
+            for i in range(max(1, config.num_env_runners))
+        ]
+        self._timesteps = 0
+        self._iteration = 0
+        self._updates = 0
+        self._sync_runners()
+
+    # -- plumbing ------------------------------------------------------------
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._timesteps / max(1, c.epsilon_decay_timesteps))
+        return c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial)
+
+    def _sync_runners(self) -> None:
+        weights = self.learner.get_weights()
+        eps = self._epsilon()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
+        ray_tpu.get([r.set_exploration.remote(eps) for r in self._runners])
+
+    @staticmethod
+    def _to_transitions(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """[T, N] rollout columns -> flat (s, a, r, s', done) transitions.
+
+        gymnasium NEXT_STEP autoreset: obs[t+1] is the episode's FINAL obs
+        when step t ended it (reset obs only appears one step later), so
+        (obs[t], a[t], r[t], obs[t+1]) is a valid transition for both
+        termination and truncation; the autoreset step itself
+        (valids==0) is junk and dropped."""
+        obs, acts = sample["obs"], sample["actions"]
+        T, N = acts.shape[0], acts.shape[1]
+        next_obs = np.concatenate(
+            [obs[1:], sample["bootstrap_obs"][None]], axis=0)
+        flat = {
+            "obs": obs.reshape((T * N,) + obs.shape[2:]),
+            "actions": acts.reshape(T * N),
+            "rewards": sample["rewards"].reshape(T * N),
+            "next_obs": next_obs.reshape((T * N,) + obs.shape[2:]),
+            "terminateds": sample["terminateds"].reshape(T * N),
+        }
+        keep = sample["valids"].reshape(T * N) > 0
+        return {k: v[keep] for k, v in flat.items()}
+
+    # -- the Tune contract ---------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        samples = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length)
+             for r in self._runners])
+        for s in samples:
+            trans = self._to_transitions(s)
+            self.buffer.add_batch(trans)
+            self._timesteps += len(trans["rewards"])
+
+        losses = []
+        if (len(self.buffer) >= cfg.num_steps_sampled_before_learning
+                and len(self.buffer) >= cfg.train_batch_size):
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                losses.append(self.learner.update(batch)["loss"])
+                self._updates += 1
+        self._sync_runners()
+
+        self._iteration += 1
+        metrics = ray_tpu.get([r.get_metrics.remote() for r in self._runners])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["num_episodes"] > 0]
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "buffer_size": len(self.buffer),
+            "num_updates": self._updates,
+            "env_steps_per_sec": (len(self._runners) * cfg.rollout_fragment_length
+                                  * cfg.num_envs_per_runner) / dt,
+            "time_total_s": dt,
+        }
+
+    def save(self, path: str) -> str:
+        from ray_tpu.train.checkpoint import save_pytree
+
+        save_pytree({"state": self.learner.get_state(),
+                     "iteration": self._iteration,
+                     "timesteps": self._timesteps}, path)
+        return path
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import load_pytree
+
+        data = load_pytree(path)
+        self.learner.set_state(data["state"])
+        self._iteration = int(data["iteration"])
+        self._timesteps = int(data["timesteps"])
+        self._sync_runners()
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

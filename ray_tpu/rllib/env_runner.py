@@ -48,6 +48,16 @@ class SingleAgentEnvRunner:
         )
         self._key = jax.device_put(jax.random.key(seed + 10_000), self._device)
         self._sample_fn = jax.jit(self.module.sample_action)
+        # Value-based algorithms (DQN family) explore epsilon-greedily over
+        # the argmax policy instead of sampling the softmax
+        # (rllib/utils/exploration/epsilon_greedy.py analog).
+        self._greedy = False
+        self._epsilon = 0.0
+        self._np_rng = np.random.default_rng(seed + 20_000)
+        self._greedy_fn = jax.jit(
+            lambda p, o: jnp.argmax(
+                self.module.forward_inference(p, o)["action_dist_inputs"],
+                axis=-1))
         self._obs, _ = self._envs.reset(seed=seed)
         # gymnasium >=1.0 vector envs autoreset on the step AFTER done
         # (NEXT_STEP mode): that step ignores the action and returns the new
@@ -79,6 +89,15 @@ class SingleAgentEnvRunner:
     def get_weights(self):
         return jax.tree.map(np.asarray, self._params)
 
+    def set_exploration(self, epsilon: float, greedy: bool = True) -> bool:
+        """Epsilon-greedy exploration for value-based learners: with prob
+        epsilon a uniform random action, else argmax over the head outputs
+        (interpreted as Q-values)."""
+        assert self.spec.discrete, "epsilon-greedy needs a discrete space"
+        self._epsilon = float(epsilon)
+        self._greedy = bool(greedy)
+        return True
+
     # -- sampling ------------------------------------------------------------
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect ``num_steps`` per sub-env; returns a columnar batch with
@@ -103,10 +122,22 @@ class SingleAgentEnvRunner:
             # numpy → CPU device directly: jnp.asarray would materialize on
             # the DEFAULT device first (a tunnel round trip per env step when
             # the default device is a remote TPU)
-            action, logp, value = self._sample_fn(
-                self._params, jax.device_put(obs, self._device), sub
-            )
-            action_np = np.asarray(action)
+            if self._greedy:
+                action = self._greedy_fn(
+                    self._params, jax.device_put(obs, self._device))
+                logp = jnp.zeros(N)
+                value = jnp.zeros(N)
+                action_np = np.asarray(action)
+                if self._epsilon > 0:
+                    explore = self._np_rng.random(N) < self._epsilon
+                    randoms = self._np_rng.integers(
+                        0, self.spec.action_dim, N)
+                    action_np = np.where(explore, randoms, action_np)
+            else:
+                action, logp, value = self._sample_fn(
+                    self._params, jax.device_put(obs, self._device), sub
+                )
+                action_np = np.asarray(action)
             env_action = action_np.astype(np.int64) if self.spec.discrete else action_np
             next_obs, reward, terminated, truncated, _ = self._envs.step(env_action)
             done = np.logical_or(terminated, truncated)

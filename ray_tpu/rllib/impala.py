@@ -143,6 +143,10 @@ class ImpalaConfig(AlgorithmConfigBase):
     num_envs_per_runner: int = 4
     rollout_fragment_length: int = 64
     num_aggregators: int = 0
+    # >0: updates run on a LearnerGroup of remote learner actors with
+    # ring-allreduced gradients (reference: impala.py:667 drives its
+    # learner group) instead of a driver-local learner.
+    num_learners: int = 0
     max_requests_in_flight: int = 2
     broadcast_interval: int = 1          # updates between weight broadcasts
     train_batch_fragments: int = 1       # fragments per learner update
@@ -175,13 +179,32 @@ class IMPALA:
                                             hidden=tuple(config.hidden))
         probe.close()
 
-        self.learner = ImpalaLearner(self.spec, {
+        learner_cfg = {
             "lr": config.lr, "gamma": config.gamma,
             "vf_loss_coeff": config.vf_loss_coeff,
             "entropy_coeff": config.entropy_coeff,
             "rho_bar": config.rho_bar, "c_bar": config.c_bar,
             "grad_clip": config.grad_clip,
-        }, seed=config.seed)
+        }
+        if config.num_learners > 0:
+            import uuid
+
+            from ray_tpu.rllib.learner import LearnerGroup
+
+            # [T, N] trajectory columns shard on the ENV axis so each
+            # learner sees whole time series; [N, ...] bootstrap rows on 0.
+            self.learner = LearnerGroup(
+                ImpalaLearner, self.spec, learner_cfg,
+                num_learners=config.num_learners,
+                group_name=f"impala-learners-{uuid.uuid4().hex[:8]}",
+                seed=config.seed,
+                shard_axes={"obs": 1, "actions": 1, "logp": 1, "values": 1,
+                            "rewards": 1, "terminateds": 1, "valids": 1,
+                            "bootstrap_obs": 0, "bootstrap_value": 0},
+            )
+        else:
+            self.learner = ImpalaLearner(self.spec, learner_cfg,
+                                         seed=config.seed)
 
         runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self._runners = [
@@ -309,6 +332,8 @@ class IMPALA:
 
     def stop(self) -> None:
         self._inflight.clear()
+        if hasattr(self.learner, "shutdown"):
+            self.learner.shutdown()
         for r in self._runners + self._aggregators:
             try:
                 ray_tpu.kill(r)

@@ -123,8 +123,16 @@ class _DistributedLearnerActor:
         from ray_tpu.parallel import collectives
 
         L = self.learner
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-        loss, grads = self._grad_fn(L.params, jbatch)
+        if any(v.size == 0 for v in batch.values()):
+            # Fewer batch rows than learners: this member got an empty
+            # shard. It must STILL join the allreduce (fixed world size) —
+            # with zero gradients, not the NaNs an empty-mean loss yields
+            # (which would poison every replica).
+            loss = float("nan")
+            grads = jax.tree.map(jnp.zeros_like, L.params)
+        else:
+            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+            loss, grads = self._grad_fn(L.params, jbatch)
         flat, treedef = jax.tree.flatten(grads)
         summed = [
             collectives.allreduce(np.asarray(g), op="sum", group_name=self.group)
@@ -160,7 +168,12 @@ class LearnerGroup:
         num_learners: int = 0,
         group_name: str = "learner_group",
         seed: int = 0,
+        shard_axes: Optional[Dict[str, int]] = None,
     ):
+        # Per-key batch-shard axis (default 0). Trajectory learners
+        # (IMPALA) shard [T, N] columns on the ENV axis (1) so V-trace's
+        # time recursion stays intact per shard.
+        self.shard_axes = dict(shard_axes or {})
         self.num_learners = num_learners
         if num_learners == 0:
             self._local = learner_cls(spec, config, seed=seed)
@@ -181,17 +194,23 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update(batch)
         n = len(self._actors)
-        rows = len(next(iter(batch.values())))
+        first_key = next(iter(batch))
+        rows = batch[first_key].shape[self.shard_axes.get(first_key, 0)]
         shard = max(1, rows // n)
         refs = []
         for i, actor in enumerate(self._actors):
             lo = i * shard
             hi = rows if i == n - 1 else (i + 1) * shard
-            refs.append(
-                actor.update_shard.remote({k: v[lo:hi] for k, v in batch.items()})
-            )
+            piece = {}
+            for k, v in batch.items():
+                axis = self.shard_axes.get(k, 0)
+                idx = [slice(None)] * v.ndim
+                idx[axis] = slice(lo, hi)
+                piece[k] = v[tuple(idx)]
+            refs.append(actor.update_shard.remote(piece))
         metrics = ray_tpu.get(refs)
-        return {"loss": float(np.mean([m["loss"] for m in metrics]))}
+        losses = [m["loss"] for m in metrics if not np.isnan(m["loss"])]
+        return {"loss": float(np.mean(losses)) if losses else float("nan")}
 
     def get_weights(self):
         if self._local is not None:
