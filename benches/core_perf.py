@@ -154,6 +154,31 @@ def bench_broadcast(results: dict, mb: int, n_nodes: int) -> None:
     results["broadcast_gbps"] = round(n_nodes * blob.nbytes / dt / 1e9, 3)
 
 
+# Regression floors for the multiprocess runtime on the 1-core CI box —
+# the standing perf gate (VERDICT r3 #1). Values are deliberately below
+# current measurements (put ~1.8-3.5 GB/s, broadcast ~0.3, actor ~550-850us
+# depending on box load) so only real regressions trip them.
+FLOORS = {
+    "put_gbps": ("min", 1.0),
+    "broadcast_gbps": ("min", 0.15),
+    "object_fetch_gbps": ("min", 0.3),
+    "small_put_get_per_s": ("min", 50_000),
+    "actor_call_latency_us": ("max", 1200.0),
+    "task_seq_latency_us": ("max", 900.0),
+}
+
+
+def check_floors(results: dict) -> list:
+    violations = []
+    for key, (kind, bound) in FLOORS.items():
+        if key not in results:
+            continue
+        v = results[key]
+        if (kind == "min" and v < bound) or (kind == "max" and v > bound):
+            violations.append(f"{key}={v} violates {kind} {bound}")
+    return violations
+
+
 def run_suite(runtime: str, quick: bool) -> dict:
     results: dict = {"runtime": runtime}
     n_seq = 100 if quick else 300
@@ -207,6 +232,9 @@ def main() -> int:
         try:
             _settle(core, cluster)
             r = run_suite("multiprocess", args.quick)
+            violations = check_floors(r)
+            r["floors"] = {k: v[1] for k, v in FLOORS.items()}
+            r["floor_violations"] = violations
             print(json.dumps(r), flush=True)
             all_results.append(r)
         finally:
@@ -219,6 +247,12 @@ def main() -> int:
         with open(path, "w") as f:
             json.dump({"results": all_results}, f, indent=1)
         print(f"wrote {path}")
+    # The floor gate is only meaningful if it can FAIL the run.
+    for r in all_results:
+        if r.get("floor_violations"):
+            print(f"FLOOR VIOLATIONS: {r['floor_violations']}",
+                  file=sys.stderr)
+            return 1
     return 0
 
 

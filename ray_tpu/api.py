@@ -199,16 +199,22 @@ def timeline() -> List[dict]:
     events = get_runtime().gcs.task_events()
     trace = []
     for e in events:
-        if e.get("state") == "FINISHED":
-            trace.append(
-                {
-                    "name": e["name"],
-                    "cat": "task",
-                    "ph": "X",
-                    "ts": (e["time"] - e.get("duration", 0)) * 1e6,
-                    "dur": e.get("duration", 0) * 1e6,
-                    "pid": e.get("node_id", "node"),
-                    "tid": e["task_id"][:8],
+        if e.get("state") in ("FINISHED", "FAILED"):
+            entry = {
+                "name": e["name"],
+                "cat": e.get("kind", "task"),
+                "ph": "X",
+                "ts": (e["time"] - e.get("duration", 0)) * 1e6,
+                "dur": e.get("duration", 0) * 1e6,
+                "pid": e.get("node_id", "node"),
+                "tid": e["task_id"][:8],
+            }
+            if e.get("trace_id"):
+                # span linkage (cross-process trace propagation)
+                entry["args"] = {
+                    "trace_id": e["trace_id"],
+                    "parent_span_id": e.get("parent_span_id"),
+                    "failed": e.get("state") == "FAILED",
                 }
-            )
+            trace.append(entry)
     return trace

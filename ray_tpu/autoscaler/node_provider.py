@@ -166,11 +166,16 @@ class TPUPodNodeProvider(NodeProvider):
 
     def __init__(self, project: str, zone: str,
                  runtime_version: str = "tpu-ubuntu2204-base",
-                 runner: Optional[Any] = None):
+                 runner: Optional[Any] = None, runtime=None):
         self.project = project
         self.zone = zone
         self.runtime_version = runtime_version
         self._runner = runner or self._subprocess_runner
+        # Control-plane view used to bind cloud instances to the NodeIDs
+        # their daemons register with (the daemon's bootstrap must pass
+        # --labels '{"instance-id": "<vm name>"}'); without the binding
+        # the autoscaler could never scale a cloud node DOWN.
+        self._runtime = runtime
         self._instances: Dict[str, NodeInstance] = {}
         self._last_poll: Dict[str, float] = {}  # describe rate limit
         self._lock = threading.Lock()
@@ -247,4 +252,22 @@ class TPUPodNodeProvider(NodeProvider):
         for inst in instances:
             if inst.status == "PENDING":
                 self._refresh_state(inst)
+            if inst.status == "RUNNING" and inst.node_id is None:
+                self._bind_node_id(inst)
         return [i for i in instances if i.status != "TERMINATED"]
+
+    def _bind_node_id(self, inst: NodeInstance) -> None:
+        """Match the VM to the NodeID its daemon registered with (by the
+        ``instance-id`` label the bootstrap passes) so the idle check and
+        scale-down see a real cluster node."""
+        if self._runtime is None:
+            return
+        try:
+            for n in self._runtime._gcs_rpc.call("list_nodes", timeout=30.0):
+                if (n.get("alive")
+                        and n.get("labels", {}).get("instance-id")
+                        == inst.instance_id):
+                    inst.node_id = n["node_id"]  # NodeID object on the wire
+                    return
+        except Exception:  # noqa: BLE001 — bind again next tick
+            pass

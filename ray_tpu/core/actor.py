@@ -83,6 +83,8 @@ class ActorHandle:
         options = (self._plain_options if not overrides
                    else resolve_options({"max_retries": 0}, overrides))
         task_args, task_kwargs = make_task_args(args, kwargs)
+        from ray_tpu.util import tracing
+
         spec = TaskSpec(
             task_id=TaskID.for_task(rt.job_id, self._actor_id),
             job_id=rt.job_id,
@@ -96,6 +98,7 @@ class ActorHandle:
             actor_method=method_name,
             sequence_number=next(self._seq),
             caller_id=self._caller_id,
+            trace_ctx=tracing.context_for_spec(),
         )
         refs = rt.submit_actor_task(spec)
         if options.num_returns in ("dynamic", "streaming"):

@@ -67,6 +67,11 @@ class Config:
     # in-flight pulled bytes capped by pull_memory_budget
     # (pull_manager.cc:801 memory budgeting).
     pull_chunk_size = _Flag(8 * 1024 * 1024)
+    # Remote fetches at or below this ride whole in one reply frame;
+    # above it they use the chunked pull that lands DIRECTLY in the local
+    # shm arena and registers this node as a new replica — so broadcasts
+    # fan out across nodes instead of serializing on the origin daemon.
+    whole_frame_fetch_max = _Flag(1 * 1024 * 1024)
     pull_chunk_concurrency = _Flag(4)
     pull_memory_budget = _Flag(512 * 1024 * 1024)
 

@@ -1128,12 +1128,13 @@ class CoreWorker:
         from ray_tpu.core.node_daemon import NodeDaemon
 
         key_bytes = oid.binary()
-        chunk_size = config().pull_chunk_size
-        # One round trip for the common case: payload comes back directly
-        # when it fits a chunk frame; only oversized replicas pay the
-        # size-then-chunks handshake.
-        reply = self._daemons.get(addr).call("fetch_or_meta", key_bytes,
-                                             chunk_size, timeout=60.0)
+        # One round trip for the common case: small payloads come back
+        # directly; bigger ones use the chunked pull that lands straight
+        # in the LOCAL arena and registers a new replica — broadcast
+        # fan-out instead of serializing every fetch on the origin daemon.
+        reply = self._daemons.get(addr).call(
+            "fetch_or_meta", key_bytes, config().whole_frame_fetch_max,
+            timeout=60.0)
         if reply is None:
             return _MISSING
         if "payload" in reply:

@@ -834,6 +834,12 @@ class GcsService:
     def record_task_event(self, event: dict) -> None:
         self.store.record_task_event(event)
 
+    def record_task_events(self, events: List[dict]) -> None:
+        """Batched form — workers flush their task-event buffers here
+        (task_event_buffer.cc → gcs_task_manager.cc)."""
+        for event in events:
+            self.store.record_task_event(event)
+
     def task_events(self) -> List[dict]:
         return self.store.task_events()
 

@@ -137,6 +137,8 @@ class RemoteFunction:
             self._exported = True
         options = resolve_options(self._default_options, overrides)
         task_args, task_kwargs = make_task_args(args, kwargs)
+        from ray_tpu.util import tracing
+
         spec = TaskSpec(
             task_id=TaskID.for_task(rt.job_id),
             job_id=rt.job_id,
@@ -146,6 +148,7 @@ class RemoteFunction:
             args=task_args,
             kwargs=task_kwargs,
             options=options,
+            trace_ctx=tracing.context_for_spec(),
         )
         refs = rt.submit_task(spec)
         if options.num_returns in ("dynamic", "streaming"):

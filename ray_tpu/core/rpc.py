@@ -97,14 +97,18 @@ def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> No
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 4 * 1024 * 1024))
-        if not chunk:
+    # recv_into a preallocated buffer: one copy, not chunk-list + join
+    # (which doubles memory traffic on multi-MB frames — the object plane's
+    # chunked pulls ride these).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise RpcConnectionError("connection closed by peer")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf  # bytes-like; avoids a final copy on multi-MB frames
 
 
 def _recv_frame(sock: socket.socket) -> Any:

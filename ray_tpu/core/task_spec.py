@@ -167,6 +167,9 @@ class TaskSpec:
     # generator items here as produced (core_worker.cc:3199
     # HandleReportGeneratorItemReturns analog). "" = no streaming reports.
     owner_addr: str = ""
+    # Tracing: (trace_id, parent_span_id) of the submitting context —
+    # cross-process span propagation (tracing_helper.py:169-175 analog).
+    trace_ctx: Optional[tuple] = None
 
     def return_object_ids(self, num: Optional[int] = None) -> List[ObjectID]:
         n = num if num is not None else (
@@ -197,7 +200,7 @@ class TaskSpec:
             self.options, self.actor_id, self.actor_method,
             self.actor_creation_class_id, self.sequence_number,
             self.caller_id, self.window_min, self.concurrency_group,
-            self.attempt_number, self.owner_addr))
+            self.attempt_number, self.owner_addr, self.trace_ctx))
 
 
 def _make_task_spec(task_id, job_id, task_type_value, *rest) -> TaskSpec:

@@ -41,6 +41,45 @@ class _DependencyFailed(Exception):
         self.error = error
 
 
+class _TaskEventBuffer:
+    """Batched task-event reporting (the reference's per-worker
+    ``task_event_buffer.cc`` → ``gcs_task_manager.cc`` pipeline): events
+    accumulate locally and a flusher ships them to the GCS once a second —
+    the execution hot path never pays a control-plane round trip."""
+
+    FLUSH_INTERVAL_S = 1.0
+    MAX_BUFFER = 1000
+
+    def __init__(self, gcs_rpc):
+        self._gcs = gcs_rpc
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._started = False
+
+    def record(self, event: dict) -> None:
+        with self._lock:
+            if len(self._buf) < self.MAX_BUFFER:
+                self._buf.append(event)
+            if not self._started:
+                self._started = True
+                threading.Thread(target=self._flush_loop,
+                                 name="task-events", daemon=True).start()
+
+    def _flush_loop(self) -> None:
+        while True:
+            time.sleep(self.FLUSH_INTERVAL_S)
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if batch:
+            try:
+                self._gcs.notify("record_task_events", batch)
+            except Exception:  # noqa: BLE001 — tracing never breaks work
+                pass
+
+
 class _ActorState:
     """A resident actor instance + its scheduling queue state."""
 
@@ -56,6 +95,20 @@ class _ActorState:
         self.slots = threading.Semaphore(max(1, max_concurrency))
         self.serial = max_concurrency <= 1
         self.loop: Optional[asyncio.AbstractEventLoop] = None  # async actors
+        # method name -> (bound method, is_coroutine): resolved once — the
+        # getattr + inspect.iscoroutinefunction pair costs ~10us per call
+        # on the hot path.
+        self.methods: Dict[str, Any] = {}
+
+    def resolve_method(self, name: str):
+        entry = self.methods.get(name)
+        if entry is None:
+            method = getattr(self.instance, name, None)
+            if method is None:
+                return None
+            entry = (method, inspect.iscoroutinefunction(method))
+            self.methods[name] = entry
+        return entry
 
     def ensure_loop(self) -> asyncio.AbstractEventLoop:
         with self.lock:
@@ -78,6 +131,7 @@ class WorkerService:
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
         self._task_lease = threading.local()
+        self._events = _TaskEventBuffer(core._gcs_rpc)
         # Blocked-worker protocol (reference: CPU released while a worker
         # blocks in ray.get — worker.py release/reacquire; prevents nested
         # task deadlock on a fully leased cluster).
@@ -136,6 +190,37 @@ class WorkerService:
 
     # ====================== normal tasks ======================
 
+    def _begin_trace(self, spec: TaskSpec) -> tuple:
+        """Adopt the caller's span context for this task's execution."""
+        from ray_tpu.util import tracing
+
+        span_id = spec.task_id.hex()[:16]
+        trace_id = spec.trace_ctx[0] if spec.trace_ctx else span_id
+        parent = spec.trace_ctx[1] if spec.trace_ctx else None
+        tracing.set_context((trace_id, span_id))
+        return (trace_id, span_id, parent, time.time())
+
+    def _end_trace(self, spec: TaskSpec, trace: tuple, ok: bool) -> None:
+        from ray_tpu.util import tracing
+
+        tracing.set_context(None)
+        trace_id, span_id, parent, started = trace
+        name = spec.function_name
+        if spec.actor_method:
+            name = f"{name}.{spec.actor_method}"
+        self._events.record({
+            "task_id": spec.task_id.hex(),
+            "name": name,
+            "state": "FINISHED" if ok else "FAILED",
+            "time": time.time(),
+            "duration": time.time() - started,
+            "node_id": self.core.current_node_id.hex()
+            if self.core.current_node_id else "",
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent,
+        })
+
     def run_task(self, spec_bytes: bytes, lease_id: str | None = None) -> dict:
         from ray_tpu.core.core_worker import arg_borrow_scope
 
@@ -144,6 +229,7 @@ class WorkerService:
         st = {"lease_id": lease_id,
               "resources": spec.declared_resources(), "released": False}
         self._task_lease.value = st
+        trace = self._begin_trace(spec)
         borrowed: set = set()
         try:
             fn = self.core.gcs.get_function(spec.function_id)
@@ -163,6 +249,7 @@ class WorkerService:
         finally:
             self._task_lease.value = None
             self.core.current_task_id = None
+        self._end_trace(spec, trace, ok=bool(out.get("ok")))
         # Borrow handover BEFORE the reply: the caller's call-duration pin
         # is released when it processes this reply, so any arg ref this
         # process still holds must be registered with its owner first
@@ -421,6 +508,7 @@ class WorkerService:
         self._admit_in_order(state, spec)
         from ray_tpu.core.core_worker import arg_borrow_scope
 
+        trace = self._begin_trace(spec)
         borrowed: set = set()
         try:
             if spec.actor_method == DAG_LOOP_METHOD:
@@ -428,19 +516,34 @@ class WorkerService:
 
                 from ray_tpu.dag.compiled_dag import actor_dag_loop
 
-                method = functools.partial(actor_dag_loop, state.instance)
+                entry = (functools.partial(actor_dag_loop, state.instance),
+                         False)
             else:
-                method = getattr(state.instance, spec.actor_method, None)
-            if method is None:
+                entry = state.resolve_method(spec.actor_method)
+            if entry is None:
                 raise AttributeError(
                     f"actor {spec.function_name} has no method "
                     f"'{spec.actor_method}'")
+            method, is_coro = entry
             with arg_borrow_scope() as borrowed:
                 args, kwargs = self._resolve_args(spec)
-            if inspect.iscoroutinefunction(method):
+            if is_coro:
+                from ray_tpu.util import tracing
+
+                ctx = tracing.current_context()
+
+                async def _traced(method=method, args=args, kwargs=kwargs,
+                                  ctx=ctx):
+                    # run_coroutine_threadsafe does not carry the caller's
+                    # contextvars across threads — re-establish the span
+                    # context inside the coroutine (its asyncio task owns a
+                    # private context copy, so concurrent methods can't
+                    # cross-contaminate).
+                    tracing.set_context(ctx)
+                    return await method(*args, **kwargs)
+
                 loop = state.ensure_loop()
-                fut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), loop)
+                fut = asyncio.run_coroutine_threadsafe(_traced(), loop)
                 result = fut.result()
             elif state.serial:
                 with state.lock:
@@ -458,6 +561,7 @@ class WorkerService:
                 spec,
                 TaskError.from_exception(
                     f"{spec.function_name}.{spec.actor_method}", exc))
+        self._end_trace(spec, trace, ok=bool(out.get("ok")))
         # Borrow handover before the reply (see run_task): an arg ref the
         # method stored in ACTOR STATE must be registered with its owner
         # before the caller's call-duration pin is released.
@@ -573,6 +677,35 @@ def _install_stack_dumper() -> None:
 def main() -> int:
     _die_with_parent()
     _install_stack_dumper()
+    if os.environ.get("RAY_TPU_PROFILE_WORKER"):
+        # Debug aid: accumulate a cProfile of every actor-task handler
+        # invocation (they run on RPC pool threads, so a main-thread
+        # profiler would see nothing) and dump pstats at exit.
+        import atexit
+        import cProfile
+
+        prof = cProfile.Profile()
+        orig = WorkerService.run_actor_task
+
+        calls = [0]
+
+        def profiled(self, spec_bytes, *a, **kw):
+            prof.enable()
+            try:
+                return orig(self, spec_bytes, *a, **kw)
+            finally:
+                prof.disable()
+                calls[0] += 1
+                if calls[0] % 200 == 0:  # workers often die by SIGKILL;
+                    # periodic dumps beat atexit
+                    prof.dump_stats(
+                        f"{os.environ['RAY_TPU_PROFILE_WORKER']}"
+                        f".{os.getpid()}")
+
+        WorkerService.run_actor_task = profiled
+        atexit.register(
+            lambda: prof.dump_stats(
+                f"{os.environ['RAY_TPU_PROFILE_WORKER']}.{os.getpid()}"))
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     daemon_address = os.environ["RAY_TPU_DAEMON_ADDRESS"]
     gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]

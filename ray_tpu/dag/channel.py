@@ -257,20 +257,25 @@ class SocketChannel:
         self._sock.sendall(len(payload).to_bytes(8, "big") + payload)
 
     def _recv_exact(self, n: int, timeout: Optional[float]) -> bytes:
+        # Partial data RIDES OVER timeouts in self._rx: a timed-out read
+        # must be retryable without desyncing the length-prefixed stream
+        # (discarding a half-received payload would make the next read
+        # parse payload bytes as a length prefix).
+        if not hasattr(self, "_rx"):
+            self._rx = bytearray()
         self._sock.settimeout(timeout)
         try:
-            chunks = []
-            got = 0
-            while got < n:
+            while len(self._rx) < n:
                 try:
-                    chunk = self._sock.recv(n - got)
+                    chunk = self._sock.recv(65536)
                 except TimeoutError as e:
                     raise ChannelTimeout(f"no data in {self.name}") from e
                 if not chunk:
                     raise ChannelClosed(self.name)
-                chunks.append(chunk)
-                got += len(chunk)
-            return b"".join(chunks)
+                self._rx.extend(chunk)
+            out = bytes(self._rx[:n])
+            del self._rx[:n]
+            return out
         finally:
             # Back to blocking mode: a lingering recv timeout would make a
             # later sendall of a large frame fail MID-WRITE and desync the

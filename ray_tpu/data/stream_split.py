@@ -74,18 +74,33 @@ class StreamSplitDataIterator(JaxBatchesMixin):
         self._coord = coordinator
         self._idx = idx
 
+    # Max seconds to sit behind the equal-split throttle before giving up —
+    # a peer that crashed (or stopped iterating) without finish() must not
+    # wedge healthy consumers forever.
+    EQUAL_WAIT_TIMEOUT_S = 300.0
+
     # -- block stream --------------------------------------------------------
     def iter_blocks(self) -> Iterator[pa.Table]:
         import time as _time
 
+        throttle_since = None
         while True:
             box = ray_tpu.get(self._coord.get_next.remote(self._idx),
                               timeout=600)
             if box is None:
                 return
             if box[0] == "__wait__":  # equal-split throttle
+                now = _time.time()
+                throttle_since = throttle_since or now
+                if now - throttle_since > self.EQUAL_WAIT_TIMEOUT_S:
+                    raise TimeoutError(
+                        f"streaming split {self._idx} throttled for "
+                        f"{self.EQUAL_WAIT_TIMEOUT_S}s behind a consumer "
+                        "that stopped iterating (call finish() on ranks "
+                        "that end early)")
                 _time.sleep(0.02)
                 continue
+            throttle_since = None
             yield ray_tpu.get(box[0], timeout=600)
 
     def iter_batches(self, *, batch_size: int = 1024,

@@ -1,0 +1,80 @@
+"""Cross-process tracing — span context rides inside the TaskSpec.
+
+Analog of the reference's OpenTelemetry task tracing
+(``python/ray/util/tracing/tracing_helper.py`` — context inject/extract
+:169-175, propagated inside the TaskSpec) without the otel dependency:
+a (trace_id, span_id) pair flows submit→execute across processes, every
+task execution emits a span event into the GCS task-event stream (the
+``task_event_buffer.cc`` → ``gcs_task_manager.cc`` pipeline), and
+``ray_tpu.timeline()`` renders the whole trace — including user spans
+opened with :func:`span` — as one chrome trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+import uuid
+from typing import Iterator, Optional, Tuple
+
+# contextvars, not threading.local: async actor methods run as tasks on a
+# shared event loop, where thread-locals leak between interleaved
+# coroutines — each asyncio task gets its own contextvars copy.
+_CTX: contextvars.ContextVar[Optional[Tuple[str, str]]] = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) active in this context, or None."""
+    return _CTX.get()
+
+
+def set_context(ctx: Optional[Tuple[str, str]]) -> None:
+    _CTX.set(ctx)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@contextlib.contextmanager
+def span(name: str, *, runtime=None) -> Iterator[Tuple[str, str]]:
+    """Open a user span: child of the active context (a fresh trace root
+    otherwise). Tasks submitted inside inherit the span as parent, across
+    process boundaries. The span event lands in the task-event stream."""
+    parent = current_context()
+    trace_id = parent[0] if parent else _new_id()
+    span_id = _new_id()
+    set_context((trace_id, span_id))
+    started = time.time()
+    try:
+        yield (trace_id, span_id)
+    finally:
+        set_context(parent)
+        event = {
+            "task_id": span_id,
+            "name": name,
+            "state": "FINISHED",
+            "kind": "span",
+            "time": time.time(),
+            "duration": time.time() - started,
+            "trace_id": trace_id,
+            "parent_span_id": parent[1] if parent else None,
+            "node_id": f"pid-{os.getpid()}",
+        }
+        try:
+            rt = runtime
+            if rt is None:
+                from ray_tpu.core.runtime import get_runtime
+
+                rt = get_runtime()
+            rt.gcs.record_task_event(event)
+        except Exception:  # noqa: BLE001 — tracing must never break work
+            pass
+
+
+def context_for_spec() -> Optional[Tuple[str, str]]:
+    """What a submitting call should stamp into the TaskSpec."""
+    return current_context()

@@ -195,3 +195,63 @@ class TestCli:
         assert out.returncode == 0, out.stderr
         data = json.loads(out.stdout[out.stdout.index("{"):])
         assert data["alive_nodes"] >= 1
+
+
+def test_cross_process_trace_propagation():
+    """Spans propagate submit -> execute across PROCESS boundaries: a task
+    tree submitted under a driver span shares one trace_id, parent links
+    form the chain, and worker-side events reach the timeline through the
+    batched task-event pipeline (tracing_helper.py + task_event_buffer.cc
+    analogs)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.cluster import Cluster, connect
+    from ray_tpu.util import tracing
+
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote
+            def child():
+                return "leaf"
+
+            @ray_tpu.remote
+            def parent_task():
+                return ray_tpu.get(child.remote(), timeout=120)
+
+            with tracing.span("root", runtime=core) as (trace_id, root_span):
+                assert ray_tpu.get(parent_task.remote(),
+                                   timeout=240) == "leaf"
+            # worker event buffers flush once a second
+            def by_suffix():
+                out = {}
+                for e in ray_tpu.timeline():
+                    for want in ("root", "parent_task", "child"):
+                        if e["name"] == want or e["name"].endswith(want):
+                            out[want] = e
+                return out
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                named = by_suffix()
+                if {"root", "parent_task", "child"} <= set(named):
+                    break
+                time.sleep(0.5)
+            named = by_suffix()
+            assert {"root", "parent_task", "child"} <= set(named), named.keys()
+            p = named["parent_task"]["args"]
+            c = named["child"]["args"]
+            assert p["trace_id"] == trace_id
+            assert c["trace_id"] == trace_id
+            assert p["parent_span_id"] == root_span
+            # child's parent is parent_task's span (the task id prefix)
+            assert c["parent_span_id"] is not None
+            assert c["parent_span_id"] != root_span
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
