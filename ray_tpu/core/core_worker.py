@@ -1314,10 +1314,12 @@ class CoreWorker:
         return refs
 
     def _submit(self, spec: TaskSpec, pending: _PendingTask) -> None:
-        renv = spec.options.runtime_env
-        if renv and renv.get("env_vars"):
+        from ray_tpu.runtime_env import needs_dedicated_worker
+
+        if needs_dedicated_worker(spec.options.runtime_env):
             # runtime_env tasks need a dedicated worker spawned with the env
-            # applied at process start — the daemon owns that; no reuse.
+            # applied at process start (and/or inside a pip venv) — the
+            # daemon owns that; no reuse.
             self._submit_pool.submit(self._run_submission, spec, pending)
         else:
             self._dispatch(_QueuedTask(spec, pending,
@@ -1728,12 +1730,14 @@ class CoreWorker:
                         TaskError(spec.function_name,
                                   f"GCS unreachable: {e}", None))
                     return
+                from ray_tpu.runtime_env import needs_dedicated_worker
+
                 renv = spec.options.runtime_env
-                env_vars = (dict(renv["env_vars"])
-                            if renv and renv.get("env_vars") else None)
+                sidecar = (dict(renv)
+                           if needs_dedicated_worker(renv) else None)
                 try:
                     result = self._daemons.get(node_addr).call(
-                        "execute_task", spec_bytes, lease_id, env_vars,
+                        "execute_task", spec_bytes, lease_id, sidecar,
                         timeout=None,
                     )
                 except Exception as e:  # noqa: BLE001

@@ -27,7 +27,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import Config, config, set_config
 from ray_tpu.core.ids import ActorID, NodeID, WorkerID
@@ -255,8 +255,122 @@ class NodeDaemon:
 
     # ====================== worker pool ======================
 
+    # Max age of an in-progress build marker before waiters treat the
+    # builder as dead (SIGKILL/OOM) and reclaim the directory. Must exceed
+    # the longest untouched build step (the pip install subprocess, 600s).
+    _PIP_BUILD_STALE_S = 700.0
+    # Waiter patience: > the builder's full worst-case budget (venv 120s +
+    # install 600s) so slow-but-succeeding builds don't fail their sharers.
+    _PIP_WAIT_S = 900.0
+
+    @staticmethod
+    def _pip_env_root() -> str:
+        """Per-uid, 0700 cache root (the reference's runtime-env agent
+        caches per node the same way): a fixed world-writable path would
+        let another local user pre-plant a poisoned env at a known key."""
+        root = f"/tmp/ray_tpu_envs-{os.getuid()}"
+        os.makedirs(root, mode=0o700, exist_ok=True)
+        st = os.stat(root)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+            raise RuntimeError(
+                f"pip env cache {root} has unsafe ownership/permissions")
+        return root
+
+    def _ensure_pip_env(self, pip_spec) -> str:
+        """Build (or reuse) a venv for a pip runtime env; returns its
+        python executable. ``pip_spec``: list of requirements, or a dict
+        with "packages" (+ "pip_install_options"). Zero-egress images can
+        only install LOCAL paths/wheels; failures surface to the
+        submitting task."""
+        import hashlib
+        import shutil as _shutil
+        import subprocess
+
+        if isinstance(pip_spec, dict):
+            packages = list(pip_spec.get("packages", []))
+            # e.g. ["--no-index", "--no-build-isolation"] — how zero-egress
+            # deployments install local wheels/trees (the reference's pip
+            # spec dict carries pip_install_options the same way).
+            pip_options = list(pip_spec.get("pip_install_options", []))
+        else:
+            packages = list(pip_spec)
+            pip_options = []
+        key = hashlib.sha1(json.dumps([packages, pip_options],
+                                      sort_keys=True).encode()).hexdigest()[:16]
+        env_dir = os.path.join(self._pip_env_root(), key)
+        python = os.path.join(env_dir, "bin", "python")
+        ready = os.path.join(env_dir, ".ready")
+        building = os.path.join(env_dir, ".building")
+        deadline = time.time() + self._PIP_WAIT_S
+        while True:
+            if os.path.exists(ready):
+                return python
+            try:
+                # mkdir is the atomic claim: exactly one builder proceeds.
+                os.makedirs(env_dir)
+            except FileExistsError:
+                # A builder claimed it. If its .building marker is ancient
+                # (or absent and the dir is old), that builder died without
+                # cleanup — reclaim so one crash can't wedge the spec
+                # until a human deletes the directory.
+                try:
+                    age = time.time() - os.stat(building).st_mtime
+                except OSError:
+                    try:
+                        age = time.time() - os.stat(env_dir).st_mtime
+                    except OSError:
+                        continue  # dir vanished: retry the claim
+                if age > self._PIP_BUILD_STALE_S:
+                    logger.warning("reclaiming stale pip env build %s "
+                                   "(builder died?)", key)
+                    _shutil.rmtree(env_dir, ignore_errors=True)
+                    continue
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"pip env {key} build by another process never "
+                        "finished")
+                time.sleep(0.5)
+                continue
+            try:
+                open(building, "w").close()
+                # --system-site-packages: jax/numpy/the framework stay
+                # importable; the venv only ADDS the requested packages.
+                subprocess.run([sys.executable, "-m", "venv",
+                                "--system-site-packages", env_dir],
+                               check=True, capture_output=True, timeout=120)
+                # When the daemon itself runs inside a venv (this image
+                # does), --system-site-packages chains to the BASE
+                # interpreter's site, not the daemon venv's — add a .pth so
+                # the parent environment's packages stay visible.
+                import sysconfig
+
+                parent_site = sysconfig.get_paths()["purelib"]
+                child_site = os.path.join(
+                    env_dir, "lib",
+                    f"python{sys.version_info.major}."
+                    f"{sys.version_info.minor}", "site-packages")
+                with open(os.path.join(child_site,
+                                       "_rtpu_parent_env.pth"), "w") as f:
+                    f.write(parent_site + "\n")
+                if packages:
+                    out = subprocess.run(
+                        [python, "-m", "pip", "install", *pip_options,
+                         *packages],
+                        capture_output=True, text=True, timeout=600)
+                    if out.returncode != 0:
+                        raise RuntimeError(
+                            f"pip install failed: {out.stderr[-1000:]}")
+                open(ready, "w").close()
+                return python
+            except BaseException:
+                import shutil as _shutil
+
+                _shutil.rmtree(env_dir, ignore_errors=True)
+                raise
+
     def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
-                      env_key: Optional[str] = None) -> _Worker:
+                      env_key: Optional[str] = None,
+                      python_exe: Optional[str] = None) -> _Worker:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         # CPU-only workers skip the TPU-runtime site hook: the axon
@@ -280,7 +394,7 @@ class NodeDaemon:
                                 f"worker-{worker_id.hex()[:12]}.log")
         log_file = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=log_file, stderr=subprocess.STDOUT,
         )
         log_file.close()  # the child holds its own fd
@@ -288,7 +402,7 @@ class NodeDaemon:
         self._workers[worker_id] = worker
         return worker
 
-    def _spawn_dedicated(self, env_vars: Dict[str, str],
+    def _spawn_dedicated(self, runtime_env: Dict[str, Any],
                          timeout: float = 60.0) -> _Worker:
         """Fresh worker with a per-task/actor runtime environment.
 
@@ -296,17 +410,24 @@ class NodeDaemon:
         (worker_pool.cc); here env-bearing workers never join the vanilla
         pool at all — they are dedicated (actors) or killed after the task.
         env_vars apply at PROCESS SPAWN, so they land before any import
-        (including sitecustomize-preloaded jax) runs in the worker.
+        (including sitecustomize-preloaded jax) runs in the worker;
+        ``pip`` specs run the worker inside a cached per-spec venv
+        (the runtime-env agent's pip plugin).
         """
         import json
 
-        key = json.dumps(env_vars, sort_keys=True)
+        env_vars = runtime_env.get("env_vars") or {}
+        python_exe = None
+        if runtime_env.get("pip"):
+            python_exe = self._ensure_pip_env(runtime_env["pip"])
+        key = json.dumps(runtime_env, sort_keys=True, default=str)
         deadline = time.time() + timeout
         with self._pool_cv:
             # Dedicated spawns don't touch _spawn_pending: that counter
             # gates the VANILLA pool only (a stuck dedicated spawn must not
             # starve ordinary tasks).
-            worker = self._spawn_worker(env_vars, env_key=key)
+            worker = self._spawn_worker(env_vars, env_key=key,
+                                        python_exe=python_exe)
             try:
                 while worker.address is None:
                     if worker.proc.poll() is not None:
@@ -449,18 +570,19 @@ class NodeDaemon:
     # ====================== task execution ======================
 
     def execute_task(self, spec_bytes: bytes, lease_id: str,
-                     env_vars: Optional[Dict[str, str]] = None) -> dict:
+                     runtime_env: Optional[Dict[str, Any]] = None) -> dict:
         """Run one task on a pooled worker; returns the worker's result meta.
 
         The reference pushes tasks from the *driver* straight to the leased
         worker (``direct_task_transport.cc:241 PushNormalTask``); we route
         through the daemon so worker identity stays private to the node and
         worker death maps cleanly to a retriable error for the caller.
-        ``env_vars`` (the spec's runtime_env, sent as a sidecar so the
-        daemon never deserializes user args) forces a fresh worker process.
+        ``runtime_env`` (sent as a sidecar so the daemon never deserializes
+        user args) forces a fresh worker process — with env_vars applied at
+        spawn and/or a cached pip venv as its interpreter.
         """
         try:
-            worker = (self._spawn_dedicated(env_vars) if env_vars
+            worker = (self._spawn_dedicated(runtime_env) if runtime_env
                       else self._pop_worker())
         except BaseException as e:  # noqa: BLE001 — lease must not leak
             self._release(lease_id)
@@ -627,10 +749,12 @@ class NodeDaemon:
         from ray_tpu.core import serialization
 
         spec = serialization.loads(spec_bytes)
+        from ray_tpu.runtime_env import needs_dedicated_worker
+
         renv = spec.options.runtime_env
-        env_vars = dict(renv["env_vars"]) if renv and renv.get("env_vars") else None
         try:
-            worker = (self._spawn_dedicated(env_vars) if env_vars
+            worker = (self._spawn_dedicated(dict(renv))
+                      if needs_dedicated_worker(renv)
                       else self._pop_worker())
         except BaseException as e:  # noqa: BLE001 — lease must not leak
             self._release(lease_id)
@@ -997,6 +1121,29 @@ class NodeDaemon:
             "shm_bytes": self._shm.bytes_in_use() if self._shm else 0,
             "heap_objects": len(self._heap),
         }
+
+    def node_stats(self) -> dict:
+        """Per-node system + store telemetry (the reference's per-node
+        dashboard/reporter agent sampling psutil — dashboard/agent.py +
+        modules/reporter)."""
+        out = self.stats()
+        out["node_id"] = self.node_id.hex()
+        out["address"] = self.address
+        out["store_capacity"] = self._shm.capacity() if self._shm else 0
+        out["store_objects"] = self._shm.num_objects() if self._shm else 0
+        out["spilled_objects"] = len(self._spilled)
+        try:
+            import psutil
+
+            out["cpu_percent"] = psutil.cpu_percent(interval=None)
+            vm = psutil.virtual_memory()
+            out["mem_total"] = vm.total
+            out["mem_available"] = vm.available
+            me = psutil.Process(os.getpid())
+            out["daemon_rss"] = me.memory_info().rss
+        except Exception:  # noqa: BLE001 — psutil optional
+            pass
+        return out
 
 
 def main(argv=None) -> int:

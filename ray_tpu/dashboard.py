@@ -58,6 +58,7 @@ class Dashboard:
         app.router.add_get(
             "/api/placement_groups", self._json(lambda: _state().list_placement_groups())
         )
+        app.router.add_get("/api/node_stats", self._json(_node_stats))
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/timeline", self._timeline)
 
@@ -106,29 +107,130 @@ class Dashboard:
     async def _index(self, request):
         from aiohttp import web
 
-        loop = asyncio.get_event_loop()
-        s = await loop.run_in_executor(None, self._summary)
-        rows = "".join(
-            f"<tr><td>{k}</td><td><pre>{json.dumps(v, indent=1, default=str)}</pre></td></tr>"
-            for k, v in s.items()
-        )
-        html = (
-            "<html><head><title>ray_tpu dashboard</title></head><body>"
-            "<h1>ray_tpu cluster</h1><table border=1>"
-            f"{rows}</table>"
-            '<p><a href="/api/cluster_summary">summary</a> · '
-            '<a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> · '
-            '<a href="/api/tasks">tasks</a> · <a href="/metrics">metrics</a> · '
-            '<a href="/timeline">timeline</a></p>'
-            "</body></html>"
-        )
-        return web.Response(text=html, content_type="text/html")
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
 
 
 def _state():
     from ray_tpu.util import state
 
     return state
+
+
+def _node_stats():
+    """Fan out to every alive node daemon's reporter endpoint (the per-node
+    dashboard-agent role, SURVEY §1 L6)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    out = []
+    daemons = getattr(rt, "_daemons", None)
+    for n in rt.gcs.alive_nodes():
+        entry = {"node_id": n.node_id.hex(), "address": n.address,
+                 "resources": n.resources}
+        if daemons is not None and n.address:
+            try:
+                entry.update(daemons.get(n.address).call("node_stats",
+                                                         timeout=10.0))
+            except Exception as e:  # noqa: BLE001 — daemon busy/dead
+                entry["error"] = str(e)
+        out.append(entry)
+    return out
+
+
+# Single-page UI: vanilla JS polling the JSON APIs — the reference ships a
+# React app (dashboard/client); this covers the same panes (cluster summary,
+# per-node utilization, actors, tasks, jobs, placement groups) without a
+# build step.
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+ header{background:#1a237e;color:#fff;padding:10px 18px;font-size:18px}
+ nav{background:#283593;padding:0 10px}
+ nav button{background:none;border:none;color:#c5cae9;padding:10px 14px;
+   cursor:pointer;font-size:14px}
+ nav button.active{color:#fff;border-bottom:3px solid #ffca28}
+ main{padding:16px;max-width:1200px}
+ table{border-collapse:collapse;width:100%;background:#fff;font-size:13px}
+ th,td{border:1px solid #ddd;padding:5px 8px;text-align:left}
+ th{background:#e8eaf6}
+ .bar{background:#e0e0e0;border-radius:3px;height:12px;width:120px;
+   display:inline-block;vertical-align:middle}
+ .bar>div{background:#3949ab;height:12px;border-radius:3px}
+ .muted{color:#777;font-size:12px}
+</style></head><body>
+<header>ray_tpu cluster</header>
+<nav id="nav"></nav>
+<main><div id="content">loading…</div>
+<p class="muted">auto-refresh 2s · raw: <a href="/api/cluster_summary">summary</a>
+ · <a href="/api/node_stats">node_stats</a> · <a href="/metrics">metrics</a>
+ · <a href="/timeline">timeline</a></p></main>
+<script>
+const TABS = {
+  Overview: renderOverview, Nodes: renderNodes, Actors: mkTable('/api/actors'),
+  Tasks: mkTable('/api/tasks'), Jobs: mkTable('/api/jobs'),
+  'Placement groups': mkTable('/api/placement_groups'),
+};
+let active = 'Overview';
+const nav = document.getElementById('nav');
+Object.keys(TABS).forEach(name => {
+  const b = document.createElement('button');
+  b.textContent = name;
+  b.onclick = () => { active = name; refresh(); };
+  nav.appendChild(b);
+});
+function setActive() {
+  [...nav.children].forEach(b =>
+    b.classList.toggle('active', b.textContent === active));
+}
+async function getJSON(u){ return (await fetch(u)).json(); }
+function bar(frac){
+  const pct = Math.round(Math.min(1, Math.max(0, frac)) * 100);
+  return `<span class="bar"><div style="width:${pct}%"></div></span> ${pct}%`;
+}
+function table(rows){
+  if (!rows || !rows.length) return '<p class="muted">none</p>';
+  const cols = Object.keys(rows[0]);
+  return '<table><tr>' + cols.map(c=>`<th>${c}</th>`).join('') + '</tr>' +
+    rows.map(r => '<tr>' + cols.map(c =>
+      `<td>${typeof r[c]==='object'?JSON.stringify(r[c]):r[c]}</td>`
+    ).join('') + '</tr>').join('') + '</table>';
+}
+function mkTable(url){
+  return async () => table(await getJSON(url));
+}
+async function renderOverview(){
+  const s = await getJSON('/api/cluster_summary');
+  return '<table>' + Object.entries(s).map(([k,v]) =>
+    `<tr><th>${k}</th><td><pre style="margin:0">${JSON.stringify(v,null,1)}</pre></td></tr>`
+  ).join('') + '</table>';
+}
+async function renderNodes(){
+  const stats = await getJSON('/api/node_stats');
+  return table(stats.map(n => ({
+    node: (n.node_id||'').slice(0,12), address: n.address||'',
+    workers: `${n.workers??'-'} (${n.idle??'-'} idle)`,
+    cpu: n.cpu_percent!==undefined ? bar(n.cpu_percent/100) : '-',
+    memory: n.mem_total ? bar(1 - n.mem_available/n.mem_total) : '-',
+    'object store': n.store_capacity ?
+      bar(n.shm_bytes/n.store_capacity) +
+      ` <span class=muted>${(n.shm_bytes/1048576).toFixed(1)}MB</span>` : '-',
+    spilled: n.spilled_objects??'-',
+    resources: JSON.stringify(n.resources||{}),
+  })));
+}
+async function refresh(){
+  setActive();
+  try {
+    document.getElementById('content').innerHTML = await TABS[active]();
+  } catch (e) {
+    document.getElementById('content').innerHTML =
+      `<p class="muted">error: ${e}</p>`;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script></body></html>
+"""
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:

@@ -22,6 +22,16 @@ _env_lock = threading.Lock()
 
 _SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda", "container"}
 _DEFERRED = {"pip", "conda", "container"}
+# Fields that force a FRESH, dedicated worker process on the multiprocess
+# runtime (env at spawn / isolated interpreter). ONE definition — the
+# submit paths and the daemon all consult this.
+_DEDICATED = {"env_vars", "pip"}
+
+
+def needs_dedicated_worker(env: Optional[Dict[str, Any]]) -> bool:
+    """Whether this runtime env requires a fresh worker process (rather
+    than a pooled vanilla one)."""
+    return bool(env) and any(env.get(k) for k in _DEDICATED)
 
 
 class RuntimeEnv(dict):

@@ -37,3 +37,38 @@ class TestDashboard:
         assert "ray_tpu cluster" in index.text
         timeline = httpx.get(f"{base}/timeline", timeout=10).json()
         assert isinstance(timeline, list)
+
+
+def test_node_stats_and_ui_on_multiprocess_cluster():
+    """The per-node agent role: /api/node_stats fans out to every daemon's
+    psutil+store reporter; / serves the SPA."""
+    import httpx
+
+    import ray_tpu
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.cluster import Cluster, connect
+    from ray_tpu.dashboard import start_dashboard
+
+    cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 1})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            dash = start_dashboard(port=0) if False else start_dashboard(
+                port=18799)
+            try:
+                stats = httpx.get(f"{dash.url}/api/node_stats",
+                                  timeout=30).json()
+                assert len(stats) == 2
+                for n in stats:
+                    assert n.get("workers") is not None, n
+                    assert n.get("store_capacity", 0) > 0, n
+                    assert "cpu_percent" in n, n
+                page = httpx.get(f"{dash.url}/", timeout=30).text
+                assert "ray_tpu cluster" in page and "renderNodes" in page
+            finally:
+                dash.stop()
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()

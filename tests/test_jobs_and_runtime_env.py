@@ -105,3 +105,61 @@ class TestJobSubmission:
         client.wait_until_finish(b)
         ids = {j["job_id"] for j in client.list_jobs()}
         assert {a, b} <= ids
+
+
+def test_pip_runtime_env_builds_isolated_venv(tmp_path):
+    """runtime_env={"pip": [...]} builds a cached venv on the node daemon
+    (the runtime-env agent's pip plugin) and runs the task inside it:
+    the package imports there and ONLY there. Zero-egress image: the
+    requirement is a local source tree."""
+    import ray_tpu
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.cluster import Cluster, connect
+
+    # a minimal installable package
+    pkg = tmp_path / "rtpu_demo_pkg"
+    (pkg / "rtpu_demo_pkg").mkdir(parents=True)
+    (pkg / "rtpu_demo_pkg" / "__init__.py").write_text("MAGIC = 1337\n")
+    (pkg / "pyproject.toml").write_text(
+        '[project]\nname = "rtpu-demo-pkg"\nversion = "0.1"\n'
+        '[build-system]\nrequires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        '[tool.setuptools]\npackages = ["rtpu_demo_pkg"]\n')
+
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote(runtime_env={"pip": {
+                "packages": [f"{pkg}"],
+                # zero-egress image: no index, no isolated build env
+                "pip_install_options": ["--no-index",
+                                        "--no-build-isolation"],
+            }})
+            def with_pkg():
+                import rtpu_demo_pkg
+
+                return rtpu_demo_pkg.MAGIC
+
+            @ray_tpu.remote
+            def without_pkg():
+                try:
+                    import rtpu_demo_pkg  # noqa: F401
+
+                    return "leaked"
+                except ImportError:
+                    return "isolated"
+
+            assert ray_tpu.get(with_pkg.remote(), timeout=600) == 1337
+            assert ray_tpu.get(without_pkg.remote(), timeout=120) == "isolated"
+            # second task with the same spec reuses the cached env (fast)
+            import time as _t
+
+            t0 = _t.time()
+            assert ray_tpu.get(with_pkg.remote(), timeout=120) == 1337
+            assert _t.time() - t0 < 60
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
