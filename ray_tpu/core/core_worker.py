@@ -139,6 +139,15 @@ class _GcsClientAdapter:
     def task_events(self) -> List[dict]:
         return self._client.call("task_events")
 
+    def poll_channel(self, channel: str, cursor: int,
+                     poll_timeout: float = 0.0):
+        """Read a pubsub channel from ``cursor``; returns (end, messages).
+        With ``poll_timeout`` 0 this is a non-blocking snapshot read (the
+        dashboard log pane's access path)."""
+        return self._client.call("poll_channel", channel, cursor,
+                                 poll_timeout,
+                                 timeout=poll_timeout + 30.0)
+
 
 class _SchedulerProxy:
     def __init__(self, client: RpcClient):
@@ -454,7 +463,8 @@ class _LeasedWorker:
 
 
 class _QueuedTask:
-    __slots__ = ("spec", "spec_bytes", "pending", "attempt", "nested_deps")
+    __slots__ = ("spec", "spec_bytes", "pending", "attempt", "nested_deps",
+                 "finished")
 
     def __init__(self, spec: TaskSpec, pending: _PendingTask,
                  refcounter: Optional["_LocalRefCounter"] = None):
@@ -471,6 +481,11 @@ class _QueuedTask:
                 refcounter.add_submitted_task_reference(oid)
         self.pending = pending
         self.attempt = 0
+        # _finish_task must release the dep pins exactly once even when an
+        # exception AFTER a terminal finish routes through the guarded
+        # catch-all (which finishes again) — a double release would free
+        # objects another in-flight task still depends on.
+        self.finished = False
 
 
 class _KeyState:
@@ -892,10 +907,40 @@ class CoreWorker:
         threading.Thread(target=self._sweep_dead_borrowers,
                          name="borrow-sweeper", daemon=True).start()
 
+    # Failed-ping strikes before a borrower is purged: fast when nothing is
+    # listening on its port (process is gone), slow when a listener exists
+    # (a live borrower merely starved — GIL held by a big pickle/jit, loaded
+    # RPC pool — must NOT lose its borrowed objects: purging it would be a
+    # distributed use-after-free).
+    _BORROW_PURGE_STRIKES_DEAD = 2      # ~10 s, corroborated by conn-refused
+    _BORROW_PURGE_STRIKES_UNSURE = 24   # ~2 min of continuous unresponsiveness
+
+    @staticmethod
+    def _borrower_listening(addr: str) -> Optional[bool]:
+        """Liveness corroboration for an unresponsive borrower: a raw TCP
+        connect to its owner-service port. The kernel accepts on the listen
+        backlog without the process's GIL, so a starved-but-alive borrower
+        still connects; a dead process's port refuses. True = listener
+        exists, False = refused (nothing bound — process gone), None =
+        unreachable (network blip; treat as unknown)."""
+        import socket as _socket
+
+        host, port = addr.rsplit(":", 1)
+        try:
+            s = _socket.create_connection((host, int(port)), timeout=2.0)
+            s.close()
+            return True
+        except ConnectionRefusedError:
+            return False
+        except OSError:
+            return None
+
     def _sweep_dead_borrowers(self) -> None:
         """Owner side: purge borrower processes that died without
         deregistering (the reference's on-worker-exit borrower collection;
-        here by probing each borrower's owner-service address)."""
+        here by probing each borrower's owner-service address, corroborated
+        by a raw listener probe so an alive-but-unresponsive borrower keeps
+        its borrows)."""
         strikes: Dict[str, int] = {}
         while not self._shutdown:
             time.sleep(5.0)
@@ -909,7 +954,10 @@ class CoreWorker:
                     strikes.pop(addr, None)
                 except (RpcConnectionError, TimeoutError):
                     strikes[addr] = strikes.get(addr, 0) + 1
-                    if strikes[addr] >= 2:
+                    threshold = self._BORROW_PURGE_STRIKES_UNSURE
+                    if self._borrower_listening(addr) is False:
+                        threshold = self._BORROW_PURGE_STRIKES_DEAD
+                    if strikes[addr] >= threshold:
                         strikes.pop(addr, None)
                         self._owner_clients.invalidate(addr)
                         self.reference_counter.purge_borrower_addr(addr)
@@ -1630,6 +1678,9 @@ class CoreWorker:
                 self._pending.pop(oid, None)
 
     def _finish_task(self, task: _QueuedTask, error, record: bool = True) -> None:
+        if task.finished:
+            return  # already terminally finished (idempotent: see _QueuedTask)
+        task.finished = True
         if record and error is not None:
             self._record_task_error(task.spec, task.pending, error)
         for dep in task.spec.dependencies():
@@ -2363,6 +2414,14 @@ class CoreWorker:
         self._gcs_rpc.close()
         if self._shm is not None:
             self._shm.close()
+        # If this worker IS the process-global runtime (cluster.connect
+        # installs it there), clear the slot — otherwise a later
+        # ``ray_tpu.init()`` in the same process finds a dead handle and
+        # every call raises "client closed".
+        from ray_tpu.core import runtime as runtime_mod
+
+        if runtime_mod._global_runtime is self:
+            runtime_mod._global_runtime = None
 
 
 _MISSING = object()

@@ -338,6 +338,11 @@ class NodeDaemon:
                 subprocess.run([sys.executable, "-m", "venv",
                                 "--system-site-packages", env_dir],
                                check=True, capture_output=True, timeout=120)
+                # Re-touch the claim marker between the two long build
+                # steps: the worst-case untouched stretch is otherwise
+                # venv(120s) + pip(600s) > _PIP_BUILD_STALE_S, letting a
+                # waiter rmtree a LIVE builder's env mid-install.
+                os.utime(building, None)
                 # When the daemon itself runs inside a venv (this image
                 # does), --system-site-packages chains to the BASE
                 # interpreter's site, not the daemon venv's — add a .pth so
@@ -884,18 +889,20 @@ class NodeDaemon:
             return {"payload": payload}
         return {"size": meta["size"]}
 
-    def fetch_object_chunk(self, object_id: bytes, offset: int,
-                           length: int) -> Optional[bytes]:
+    def fetch_object_chunk(self, object_id: bytes, offset: int, length: int):
         """One chunk of a replica (``object_manager.cc:812`` chunked
-        transfer): bounded frames instead of one object-sized frame."""
+        transfer): bounded frames instead of one object-sized frame.
+        Served as an out-of-band :class:`Raw` view straight out of the shm
+        arena — the socket write is the only copy this process makes, and
+        the shm refcount is held until the frame is on the wire."""
         if self._shm is not None:
             key = self._shm_key(object_id)
             view = self._shm.get(key)
             if view is not None:
-                try:
-                    return bytes(view[offset:offset + length])
-                finally:
-                    self._shm.release(key)
+                from ray_tpu.core.rpc import Raw
+
+                return Raw(view[offset:offset + length],
+                           release=lambda k=key: self._shm.release(k))
         with self._heap_lock:
             blob = self._heap.get(object_id)
             if blob is not None:

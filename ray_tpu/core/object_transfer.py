@@ -71,15 +71,28 @@ class PullManager:
             from ray_tpu.core.serialization import fast_copy_into
 
             client: RpcClient = self._clients.get(addr)
+            dest_mv = memoryview(dest).cast("B")
             offsets = list(range(0, size, self._chunk))
             inflight = []  # (offset, future)
             next_i = 0
+
+            def abort() -> bool:
+                # Abandoning the pull: revoke every remaining zero-copy
+                # landing FIRST — the caller will free/reuse ``dest``, and
+                # a late reply must not be received into it (rpc.py
+                # release_dests).
+                client.release_dests([f for _, _, f in inflight])
+                return False
+
             while next_i < len(offsets) or inflight:
                 while next_i < len(offsets) and len(inflight) < self._window:
                     off = offsets[next_i]
                     length = min(self._chunk, size - off)
+                    # _dest: the reply's raw bytes land straight in the
+                    # arena slice — zero user-space copies on this side.
                     inflight.append((off, length, client.call_async(
-                        "fetch_object_chunk", key, off, length)))
+                        "fetch_object_chunk", key, off, length,
+                        _dest=dest_mv[off:off + length])))
                     next_i += 1
                 off, length, fut = inflight.pop(0)
                 try:
@@ -87,9 +100,14 @@ class PullManager:
                 except Exception:  # noqa: BLE001 — conn loss / timeout
                     logger.warning("chunk pull %s@%d from %s failed",
                                    key.hex()[:12], off, addr)
-                    return False
-                if chunk is None or len(chunk) != length:
-                    return False
+                    inflight.append((off, length, fut))  # revoke this one too
+                    return abort()
+                if chunk is None:
+                    return abort()
+                if getattr(fut, "dest_written", False):
+                    continue  # already in place (direct-landing reply)
+                if len(chunk) != length:
+                    return abort()
                 fast_copy_into(dest, off, chunk)
             return True
         finally:
@@ -111,15 +129,19 @@ class PushManager:
         size = len(view)
         client: RpcClient = self._clients.get(addr)
         try:
+            from ray_tpu.core.rpc import Raw
+
             client.call("begin_spill_put", key, size, timeout=60.0)
             inflight = []
             off = 0
             while off < size or inflight:
                 while off < size and len(inflight) < self._window:
                     length = min(self._chunk, size - off)
+                    # Raw: the socket write reads straight from the source
+                    # buffer — no per-chunk bytes() copy on this side.
                     inflight.append(client.call_async(
                         "spill_put_chunk", key, off,
-                        bytes(view[off:off + length])))
+                        Raw(view[off:off + length])))
                     off += length
                 inflight.pop(0).result(timeout=120.0)
             client.call("commit_spill_put", key, size, timeout=60.0)

@@ -18,6 +18,22 @@ Requests multiplex over one connection: each carries a request id and replies
 may arrive out of order (the reference gets this from HTTP/2 streams; we get
 it from a reader thread matching ids to futures).
 
+Bulk payloads ride OUT-OF-BAND (pickle protocol 5): any buffer ≥
+``OOB_MIN_BYTES`` inside a message is stripped from the pickle stream and
+streamed raw after a wrapper frame::
+
+    8B len | pickle(("oob", request_id, [sizes...], inner_pickle)) | raw...
+
+so a multi-MB numpy array or shm view crosses the socket with ZERO
+user-space copies on the sender (``sendall`` straight from the source
+buffer) and exactly one on the receiver (kernel → scratch, reconstructed as
+views). Replies can go further: a client that registered a destination
+buffer for a request id (``call_async(..., _dest=view)``) gets the raw
+bytes received DIRECTLY into that buffer — the object plane's chunked
+pulls land in the shm arena without ever existing twice in host RAM
+(the reference gets the same effect from plasma fd-passing +
+``src/ray/object_manager/object_buffer_pool.cc`` chunk reuse).
+
 Security: frames are pickled, so any peer that can connect gets arbitrary
 code execution — bind ``--host`` to loopback or a mesh-internal interface
 ONLY. For non-loopback bindings set ``RAY_TPU_AUTH_TOKEN`` (propagated to
@@ -51,6 +67,117 @@ def _auth_token() -> bytes:
 # Hard cap on a single frame (control messages are small; sealed objects can
 # be fetched in one frame — match the reference's practical object sizes).
 MAX_FRAME = 16 * 1024 * 1024 * 1024
+
+# Buffers at or above this size are stripped out of the pickle stream and
+# streamed raw (see module docstring). Below it, the syscall + bookkeeping
+# costs more than the copy it saves. RAY_TPU_RPC_OOB=0 disables the raw
+# path entirely (A/B benching + emergency fallback): Raw wrappers then
+# serialize in-band as plain bytes.
+import os as _os
+
+if _os.environ.get("RAY_TPU_RPC_OOB", "1") == "0":
+    OOB_MIN_BYTES = 1 << 62
+else:
+    OOB_MIN_BYTES = 256 * 1024
+
+_RAW_SCOPE = threading.local()
+
+
+def _raw_identity(buf):
+    return buf
+
+
+class Raw:
+    """Zero-copy send wrapper: ``Raw(view)`` anywhere inside an RPC message
+    serializes the buffer out-of-band — the sender's socket write reads
+    straight from ``view`` (e.g. a shm arena slot), no intermediate bytes.
+    The receiver sees a ``memoryview``/``bytes`` in its place.
+
+    ``release`` (optional) fires exactly once after the frame carrying this
+    buffer has been fully written to the socket (or the send failed) — the
+    hook for shm refcount release on served object chunks."""
+
+    __slots__ = ("view", "_release")
+
+    def __init__(self, buf, release: Optional[Callable[[], None]] = None):
+        self.view = memoryview(buf).cast("B")
+        self._release = release
+
+    def release_once(self) -> None:
+        r, self._release = self._release, None
+        if r is not None:
+            try:
+                r()
+            except Exception:  # noqa: BLE001 — refcount bookkeeping only
+                logger.exception("Raw release hook failed")
+
+    def __len__(self) -> int:
+        return self.view.nbytes
+
+    def __reduce_ex__(self, protocol):
+        scope = getattr(_RAW_SCOPE, "raws", None)
+        if scope is not None:
+            scope.append(self)
+        return (_raw_identity, (pickle.PickleBuffer(self.view),))
+
+
+def _dumps_frame(message: Tuple) -> Tuple[bytes, list, list]:
+    """Serialize an RPC message with out-of-band bulk buffers.
+
+    Returns ``(header, bufs, raws)``: if ``bufs`` is empty, ``header`` is a
+    legacy whole-message pickle; otherwise ``header`` is the "oob"-wrapped
+    frame payload and ``bufs`` are the raw buffers to stream after it.
+    ``raws`` are :class:`Raw` wrappers whose ``release_once`` the sender
+    must call after the socket write."""
+    import io as _io
+
+    import cloudpickle
+
+    from ray_tpu.core.serialization import _FastPickler
+
+    bufs: list = []
+    raws: list = []
+    prev_scope = getattr(_RAW_SCOPE, "raws", None)
+    _RAW_SCOPE.raws = raws
+
+    def _cb(pb: pickle.PickleBuffer):
+        mv = pb.raw()
+        if mv.nbytes < OOB_MIN_BYTES:
+            return True  # keep small buffers in-band
+        bufs.append(mv)
+        return False
+
+    try:
+        try:
+            out = _io.BytesIO()
+            _FastPickler(out, protocol=5, buffer_callback=_cb).dump(message)
+            inner = out.getvalue()
+        except Exception:  # noqa: BLE001 — __main__-defined / unpicklable
+            bufs.clear()
+            del raws[:]
+            inner = cloudpickle.dumps(message, protocol=5, buffer_callback=_cb)
+    except BaseException:
+        for r in raws:  # pickling died: nobody else will fire the releases
+            r.release_once()
+        raise
+    finally:
+        _RAW_SCOPE.raws = prev_scope
+    if not bufs:
+        return inner, [], raws
+    req_id = message[1] if len(message) > 2 else 0
+    header = pickle.dumps(
+        ("oob", req_id, [b.nbytes for b in bufs], inner),
+        protocol=pickle.HIGHEST_PROTOCOL)
+    return header, bufs, raws
+
+
+def _send_frame_oob(sock: socket.socket, header: bytes, bufs: list,
+                    lock: threading.Lock) -> None:
+    """One frame + its raw continuation, atomically w.r.t. other senders."""
+    with lock:
+        sock.sendall(_LEN.pack(len(header)) + header)
+        for b in bufs:
+            sock.sendall(b)
 
 
 class BoundedSet:
@@ -111,11 +238,47 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf  # bytes-like; avoids a final copy on multi-MB frames
 
 
-def _recv_frame(sock: socket.socket) -> Any:
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    n = view.nbytes
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise RpcConnectionError("connection closed by peer")
+        got += r
+
+
+def _recv_frame(sock: socket.socket, dest_resolver=None) -> Any:
+    """Read one message; transparently consumes "oob" raw continuations.
+
+    ``dest_resolver(req_id, sizes)`` (client read loops only) may return a
+    writable memoryview to receive a single-buffer continuation directly —
+    the zero-copy landing path for chunked object pulls. Returns the
+    message, with out-of-band buffers reconstructed as views."""
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
-    return pickle.loads(_recv_exact(sock, length))
+    msg = pickle.loads(_recv_exact(sock, length))
+    if not (isinstance(msg, tuple) and msg and msg[0] == "oob"):
+        return msg
+    _, req_id, sizes, inner = msg
+    total = sum(sizes)
+    if total > MAX_FRAME:
+        raise RpcError(f"oob continuation too large: {total}")
+    dest = None
+    if dest_resolver is not None and len(sizes) == 1:
+        dest = dest_resolver(req_id, sizes[0])
+    if dest is not None:
+        _recv_exact_into(sock, dest)
+        views = [dest]
+    else:
+        scratch = memoryview(bytearray(total))
+        _recv_exact_into(sock, scratch)
+        views, off = [], 0
+        for s in sizes:
+            views.append(scratch[off:off + s])
+            off += s
+    return pickle.loads(inner, buffers=views)
 
 
 def _dumps(message: Tuple) -> bytes:
@@ -288,6 +451,8 @@ class RpcServer:
 
     def _run_request(self, conn, send_lock, req_id, method, data,
                      client_id: str = "") -> None:
+        bufs: list = []
+        raws: list = []
         try:
             args, kwargs = data
             fn = getattr(self._handler, method, None)
@@ -296,7 +461,7 @@ class RpcServer:
             if getattr(fn, "_rpc_wants_conn", False):
                 kwargs = dict(kwargs, _client_id=client_id)
             result = fn(*args, **kwargs)
-            frame = _dumps(("rep", req_id, method, result))
+            frame, bufs, raws = _dumps_frame(("rep", req_id, method, result))
         except BaseException as exc:  # noqa: BLE001 — propagate to caller
             tb = traceback.format_exc()
             try:
@@ -308,9 +473,12 @@ class RpcServer:
                      (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
                 )
         try:
-            _send_frame(conn, frame, send_lock)
+            _send_frame_oob(conn, frame, bufs, send_lock)
         except OSError:
             pass  # caller is gone; nothing to do
+        finally:
+            for r in raws:
+                r.release_once()
 
     def stop(self) -> None:
         self._stopped.set()
@@ -325,6 +493,10 @@ class RpcServer:
                 except OSError:
                     pass
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+# Sentinel: a registered reply destination that the read loop has filled.
+_DEST_WRITTEN = memoryview(b"")
 
 
 class RpcClient:
@@ -351,6 +523,9 @@ class RpcClient:
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
+        # req_id → writable memoryview: replies for these ids land their
+        # raw continuation directly in the buffer (zero-copy pulls).
+        self._pending_dest: Dict[int, memoryview] = {}
         self._next_id = 0
         self._closed = False
 
@@ -394,14 +569,30 @@ class RpcClient:
             ).start()
             return sock
 
+    def _resolve_dest(self, req_id: int, size: int):
+        """Hand the read loop a registered landing buffer for this reply's
+        raw continuation — only when the size matches exactly (a partial
+        chunk or an unexpected reply shape falls back to the scratch path)."""
+        with self._state_lock:
+            dest = self._pending_dest.get(req_id)
+            if dest is None or dest.nbytes != size:
+                return None
+            # Consumed: mark so the caller knows the bytes are in place.
+            self._pending_dest[req_id] = _DEST_WRITTEN
+            return dest
+
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                kind, req_id, _method, data = _recv_frame(sock)
+                kind, req_id, _method, data = _recv_frame(
+                    sock, dest_resolver=self._resolve_dest)
                 with self._state_lock:
                     fut = self._pending.pop(req_id, None)
+                    dest_state = self._pending_dest.pop(req_id, None)
                 if fut is None:
                     continue
+                if dest_state is _DEST_WRITTEN:
+                    fut.dest_written = True  # read by PullManager.pull_into
                 if kind == "rep":
                     fut.set_result(data)
                 else:
@@ -415,6 +606,7 @@ class RpcClient:
     def _fail_all(self, error: Exception) -> None:
         with self._state_lock:
             pending, self._pending = self._pending, {}
+            self._pending_dest.clear()
             if self._sock is not None:
                 try:
                     self._sock.close()
@@ -427,18 +619,28 @@ class RpcClient:
 
     # -- calls ------------------------------------------------------------------
 
-    def call_async(self, method: str, *args, **kwargs) -> Future:
+    def call_async(self, method: str, *args,
+                   _dest: Optional[memoryview] = None, **kwargs) -> Future:
+        """``_dest``: optional writable buffer; if the reply carries exactly
+        one out-of-band payload of ``_dest.nbytes``, it is received straight
+        into it and ``fut.dest_written`` is True."""
         sock = self._ensure_connected()
         with self._state_lock:
             req_id = self._next_id
             self._next_id += 1
             fut: Future = Future()
+            fut.req_id = req_id  # for release_dests on abandoned calls
             self._pending[req_id] = fut
-        frame = _dumps(("req", req_id, method, (args, kwargs)))
+            if _dest is not None:
+                self._pending_dest[req_id] = memoryview(_dest).cast("B")
+        frame, bufs, raws = _dumps_frame(("req", req_id, method, (args, kwargs)))
         try:
-            _send_frame(sock, frame, self._send_lock)
+            _send_frame_oob(sock, frame, bufs, self._send_lock)
         except OSError as e:
             self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
+        finally:
+            for r in raws:
+                r.release_once()
         return fut
 
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
@@ -450,14 +652,51 @@ class RpcClient:
             # callers catch domain errors (ValueError, TaskError...) natively.
             raise e.cause from e
 
+    def release_dests(self, futs, wait_timeout: float = 30.0) -> None:
+        """Revoke the registered reply destinations of abandoned calls.
+
+        A caller that gives up on ``_dest`` calls (timeout, partial-chunk
+        failure) MUST revoke before freeing the destination memory — a
+        late-arriving reply would otherwise be received straight into a
+        buffer that now belongs to someone else. Unconsumed registrations
+        are removed under the state lock (the read loop then falls back to
+        scratch); a registration the read loop has already claimed is
+        mid-``recv_into``, so we block on that future, and if it doesn't
+        resolve in ``wait_timeout`` the connection is torn down — killing
+        the socket is the only way to stop an in-flight landing."""
+        consumed = []
+        with self._state_lock:
+            for fut in futs:
+                req_id = getattr(fut, "req_id", None)
+                if req_id is None:
+                    continue
+                dest = self._pending_dest.get(req_id)
+                if dest is None:
+                    continue
+                if dest is _DEST_WRITTEN:
+                    consumed.append(fut)
+                else:
+                    del self._pending_dest[req_id]
+        for fut in consumed:
+            try:
+                fut.result(timeout=wait_timeout)
+            except Exception:  # noqa: BLE001 — includes our own timeout
+                if not fut.done():
+                    self._fail_all(RpcConnectionError(
+                        "connection torn down: abandoned zero-copy landing "
+                        "did not complete"))
+
     def notify(self, method: str, *args, **kwargs) -> None:
         sock = self._ensure_connected()
-        frame = _dumps(("note", 0, method, (args, kwargs)))
+        frame, bufs, raws = _dumps_frame(("note", 0, method, (args, kwargs)))
         try:
-            _send_frame(sock, frame, self._send_lock)
+            _send_frame_oob(sock, frame, bufs, self._send_lock)
         except OSError as e:
             self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
             raise RpcConnectionError(str(e)) from e
+        finally:
+            for r in raws:
+                r.release_once()
 
     def close(self) -> None:
         with self._state_lock:
