@@ -367,19 +367,27 @@ class _DistributedGroup:
     def p2p_recv(self, src: int, dst: int,
                  timeout: Optional[float] = 60.0):
         # Matching monotone counters on both ends keep repeated send/recv
-        # pairs FIFO-ordered. The recv counter commits only on SUCCESS: a
-        # timed-out recv must leave the cursor on the same tag so a retry
-        # consumes the late-arriving message instead of desyncing forever.
+        # pairs FIFO-ordered. The cursor is RESERVED under the lock before
+        # blocking — two concurrent recvs for the same (src, dst) get
+        # distinct tags instead of racing for one message and stranding the
+        # loser on a tag the sender has moved past. A timed-out recv rolls
+        # its reservation back (only if it is still the newest — with a
+        # later recv outstanding the gap is unrecoverable either way) so a
+        # single-threaded retry consumes the late-arriving message.
         key = ("p2p_ctr", src, dst, "recv")
         with self._op_lock:
             d = getattr(self, "_p2p_counts", None)
             if d is None:
                 d = self._p2p_counts = {}
             nxt = d.get(key, 0) + 1
-        value = self._recv(("p2p", src, dst, nxt), timeout)
-        with self._op_lock:
-            self._p2p_counts[key] = nxt
-        return value
+            d[key] = nxt
+        try:
+            return self._recv(("p2p", src, dst, nxt), timeout)
+        except BaseException:
+            with self._op_lock:
+                if self._p2p_counts.get(key) == nxt:
+                    self._p2p_counts[key] = nxt - 1
+            raise
 
     def _p2p_counter(self, src: int, dst: int, direction: str) -> int:
         key = ("p2p_ctr", src, dst, direction)

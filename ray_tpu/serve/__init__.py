@@ -8,6 +8,8 @@ from ray_tpu.serve.api import (
     delete,
     drain_proxy,
     get_deployment_handle,
+    grpc_proxy_address,
+    proxy_grpc_addresses,
     run,
     shutdown,
     start_proxies,
@@ -35,4 +37,8 @@ __all__ = [
     "batch",
     "multiplexed",
     "get_multiplexed_model_id",
+    "start_proxies",
+    "drain_proxy",
+    "proxy_grpc_addresses",
+    "grpc_proxy_address",
 ]

@@ -85,11 +85,15 @@ def grpc_proxy_address() -> Optional[str]:
 _proxy_manager = None
 
 
-def start_proxies(port: int = 0) -> Dict[str, str]:
+def start_proxies(port: int = 0, grpc: bool = False,
+                  grpc_port: int = 0) -> Dict[str, str]:
     """Start (or reconcile) per-node DETACHED proxy actors and return
     node_id -> http address. Unlike the driver-thread proxy
     (``_start_proxy=True``), these survive driver exit and support drain
-    (reference: serve/_private/proxy_state.py)."""
+    (reference: serve/_private/proxy_state.py). ``grpc=True`` additionally
+    serves the gRPC ingress from the same per-node actors (reference:
+    ``serve/_private/proxy.py:533 gRPCProxy`` beside the HTTP half);
+    addresses via :func:`proxy_grpc_addresses`."""
     global _proxy_manager
     if not ray_tpu.is_initialized():
         ray_tpu.init()
@@ -97,8 +101,23 @@ def start_proxies(port: int = 0) -> Dict[str, str]:
     if _proxy_manager is None:
         from ray_tpu.serve.proxy_state import ProxyManager
 
-        _proxy_manager = ProxyManager(CONTROLLER_NAME, port=port)
+        _proxy_manager = ProxyManager(
+            CONTROLLER_NAME, port=port,
+            grpc_port=grpc_port if grpc else None)
+    elif grpc and _proxy_manager._grpc_port is None:
+        # Fleet already running HTTP-only: upgrade the live actors in
+        # place rather than silently dropping the request.
+        addrs = _proxy_manager.sync()
+        _proxy_manager.enable_grpc(grpc_port)
+        return addrs
     return _proxy_manager.sync()
+
+
+def proxy_grpc_addresses() -> Dict[str, str]:
+    """node_id -> gRPC ingress address of the per-node proxy fleet."""
+    if _proxy_manager is None:
+        return {}
+    return _proxy_manager.grpc_addresses()
 
 
 def drain_proxy(node_id: str, timeout_s: float = 30.0) -> bool:

@@ -138,12 +138,25 @@ class ServeControllerActor:
         with self._lock:
             table = {}
             for name, t in self._targets.items():
-                reps = [r for v, r in self._replicas.get(name, [])
-                        if v == t.version]
-                if not reps:
-                    # Mid-rollout window: keep routing to the outgoing
-                    # version rather than publishing an empty replica set.
-                    reps = [r for _v, r in self._replicas.get(name, [])]
+                all_reps = self._replicas.get(name, [])
+                fresh = [r for v, r in all_reps if v == t.version]
+                ready = [r for r in fresh
+                         if r.actor_id.hex() in self._ready]
+                outgoing = [r for v, r in all_reps if v != t.version]
+                # Rolling redeploy gate: NEW-version replicas join routing
+                # only once they pass readiness, and the outgoing fleet
+                # keeps serving ALONGSIDE them until it retires (reconcile
+                # drains it once every fresh replica is ready) — shifting
+                # 100% of traffic onto the first ready new replica would
+                # overload it mid-rollout (the reference's rolling update
+                # keeps both serving the same way,
+                # serve/_private/deployment_state.py). On a first deploy
+                # there is no outgoing version: route to the initializing
+                # replicas so requests queue instead of 503ing.
+                if outgoing:
+                    reps = ready + outgoing
+                else:
+                    reps = ready or fresh
                 table[name] = {
                     "replicas": reps,
                     "max_ongoing_requests": t.config.max_ongoing_requests,
@@ -250,6 +263,10 @@ class ServeControllerActor:
         # one keeps serving; old replicas then retire (unrouted, drained)
         # rather than being killed under live requests
         # (deployment_state.py's rolling update).
+        # Readiness transitions re-publish the routing table: get_snapshot
+        # gates new-version replicas on self._ready, so a replica turning
+        # ready must bump the long-poll version or routers never pick it up.
+        ready_before = set(self._ready)
         for name, t in targets.items():
             current = self._replicas.setdefault(name, [])
             fresh = [(v, r) for v, r in current if v == t.version]
@@ -278,7 +295,11 @@ class ServeControllerActor:
                 self._retiring.setdefault(name, []).append(
                     (victim, time.monotonic(), None))
                 changed = True
-            if stale and self._all_ready(r for _v, r in fresh):
+            # Probe readiness EVERY tick (not only mid-rollout): the
+            # routing gate above needs self._ready populated for first
+            # deploys and scale-ups too.
+            fresh_all_ready = self._all_ready(r for _v, r in fresh)
+            if stale and fresh_all_ready:
                 # New version fully up AND ready (answered check_health):
                 # stop routing to the old one (the snapshot lists
                 # current-version replicas) and drain it. Until then the
@@ -297,7 +318,7 @@ class ServeControllerActor:
                     for _, r in self._replicas.pop(name))
                 changed = True
         self._collect_retired()
-        if changed:
+        if changed or self._ready != ready_before:
             with self._lock:
                 self._version += 1
 

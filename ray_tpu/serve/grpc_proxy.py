@@ -117,13 +117,61 @@ class GrpcProxy:
             handlers=(_GenericServeHandler(self),),
         )
         self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
         self.address = f"{host}:{self.port}"
+        # Drain protocol (reference: serve/_private/proxy_state.py, same
+        # semantics as HttpProxy): a draining ingress rejects NEW calls
+        # with UNAVAILABLE but lets in-flight ones finish.
+        self._draining = False
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
 
     def start(self) -> None:
         self._server.start()
 
     def stop(self) -> None:
         self._server.stop(grace=1.0)
+
+    @property
+    def num_in_flight(self) -> int:
+        with self._in_flight_lock:
+            return self._in_flight
+
+    def begin_drain(self) -> None:
+        # Under _in_flight_lock: _enter checks the flag and increments
+        # under the same lock, so once this returns every accepted call is
+        # VISIBLE in num_in_flight — no check-then-act window where a call
+        # slips past the drain check but isn't counted yet.
+        with self._in_flight_lock:
+            self._draining = True
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop accepting new calls; True once none is in flight."""
+        import time as _time
+
+        self.begin_drain()
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            if self.num_in_flight == 0:
+                return True
+            _time.sleep(0.02)
+        return self.num_in_flight == 0
+
+    def _enter(self, context) -> None:
+        import grpc
+
+        with self._in_flight_lock:
+            if self._draining:
+                draining = True
+            else:
+                draining = False
+                self._in_flight += 1
+        if draining:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "proxy draining")
+
+    def _exit(self) -> None:
+        with self._in_flight_lock:
+            self._in_flight -= 1
 
     # -- request path ---------------------------------------------------------
 
@@ -183,16 +231,27 @@ class GrpcProxy:
         return json.dumps(value).encode()
 
     def _handle_unary(self, payload: bytes, context) -> Any:
-        handle, pickled = self._resolve(context)
-        value = self._loads(payload, pickled)
-        # Honor the client's RPC deadline so stuck deployments can't pin
-        # the ingress thread pool for the full default.
-        remaining = context.time_remaining()
-        timeout = min(60.0, remaining) if remaining is not None else 60.0
-        result = handle.remote(value).result(timeout_s=timeout)
-        return self._dumps(result, pickled)
+        self._enter(context)
+        try:
+            handle, pickled = self._resolve(context)
+            value = self._loads(payload, pickled)
+            # Honor the client's RPC deadline so stuck deployments can't pin
+            # the ingress thread pool for the full default.
+            remaining = context.time_remaining()
+            timeout = min(60.0, remaining) if remaining is not None else 60.0
+            result = handle.remote(value).result(timeout_s=timeout)
+            return self._dumps(result, pickled)
+        finally:
+            self._exit()
 
     def _handle_stream(self, payload: bytes, context):
+        self._enter(context)
+        try:
+            yield from self._handle_stream_inner(payload, context)
+        finally:
+            self._exit()
+
+    def _handle_stream_inner(self, payload: bytes, context):
         """Stream items honoring the client's deadline: a drainer thread
         feeds a BOUNDED queue (backpressure: a fast replica can't flood the
         ingress), and the HANDLER thread (the scarce pool resource) gives up

@@ -57,9 +57,15 @@ class HttpProxy:
         with self._in_flight_lock:
             return self._in_flight
 
+    def begin_drain(self) -> None:
+        # Set under the in-flight lock so _handle's check+increment (same
+        # lock) can't slip a request past the drain check uncounted.
+        with self._in_flight_lock:
+            self._draining = True
+
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Stop accepting new requests; True once no request is in flight."""
-        self._draining = True
+        self.begin_drain()
         deadline = time.time() + timeout_s
         while time.time() < deadline:
             if self.num_in_flight == 0:
@@ -110,12 +116,14 @@ class HttpProxy:
     async def _handle(self, request):
         from aiohttp import web
 
-        if self._draining:
+        with self._in_flight_lock:
+            draining = self._draining
+            if not draining:
+                self._in_flight += 1
+        if draining:
             return web.Response(
                 status=503, text="proxy draining",
                 headers={"Connection": "close"})
-        with self._in_flight_lock:
-            self._in_flight += 1
         try:
             return await self._handle_inner(request)
         finally:

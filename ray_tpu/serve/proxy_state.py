@@ -17,14 +17,24 @@ PROXY_NAME_PREFIX = "SERVE_PROXY"
 
 
 class _ProxyActorImpl:
-    """Hosts one HttpProxy inside a cluster worker process."""
+    """Hosts one HttpProxy (and optionally one GrpcProxy) inside a cluster
+    worker process — the reference's proxy actor runs both ingress
+    protocols in one process the same way
+    (``serve/_private/proxy.py:533 gRPCProxy`` beside the HTTP half)."""
 
-    def __init__(self, controller_name: str, port: int = 0):
+    def __init__(self, controller_name: str, port: int = 0,
+                 grpc_port: int | None = None):
         from ray_tpu.serve.proxy import HttpProxy
 
-        controller = ray_tpu.get_actor(controller_name)
-        self._proxy = HttpProxy(controller, port=port)
+        self._controller = ray_tpu.get_actor(controller_name)
+        self._proxy = HttpProxy(self._controller, port=port)
         self._proxy.start()
+        self._grpc = None
+        if grpc_port is not None:
+            from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+            self._grpc = GrpcProxy(self._controller, port=grpc_port)
+            self._grpc.start()
 
     def address(self) -> str:
         # The proxy binds this host; report the interface clients reach the
@@ -32,17 +42,49 @@ class _ProxyActorImpl:
         host = self._proxy.host
         return f"{host}:{self._proxy.bound_port}"
 
+    def grpc_address(self) -> str | None:
+        return self._grpc.address if self._grpc is not None else None
+
+    def ensure_grpc(self, port: int = 0) -> str:
+        """Start the gRPC ingress in this (already running) proxy actor if
+        it isn't serving yet — the upgrade path for fleets that were
+        created HTTP-only."""
+        if self._grpc is None:
+            from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+            self._grpc = GrpcProxy(self._controller, port=port)
+            self._grpc.start()
+        return self._grpc.address
+
     def ready(self) -> bool:
         return self._proxy.bound_port is not None
 
     def num_in_flight(self) -> int:
-        return self._proxy.num_in_flight
+        n = self._proxy.num_in_flight
+        if self._grpc is not None:
+            n += self._grpc.num_in_flight
+        return n
 
     def drain(self, timeout_s: float = 30.0) -> bool:
-        return self._proxy.drain(timeout_s)
+        # Both protocols stop accepting IMMEDIATELY, then wait on ONE
+        # shared deadline (sequential waits would double the caller's
+        # timeout under stuck in-flight requests).
+        import time as _time
+
+        self._proxy.begin_drain()
+        if self._grpc is not None:
+            self._grpc.begin_drain()
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            if self.num_in_flight() == 0:
+                return True
+            _time.sleep(0.02)
+        return self.num_in_flight() == 0
 
     def stop(self) -> bool:
         self._proxy.stop()
+        if self._grpc is not None:
+            self._grpc.stop()
         return True
 
 
@@ -55,11 +97,14 @@ class ProxyManager:
     kill.
     """
 
-    def __init__(self, controller_name: str, port: int = 0):
+    def __init__(self, controller_name: str, port: int = 0,
+                 grpc_port: int | None = None):
         self._controller_name = controller_name
         self._port = port
+        self._grpc_port = grpc_port
         self._proxies: Dict[str, object] = {}   # node_id -> actor handle
         self._addresses: Dict[str, str] = {}
+        self._grpc_addresses: Dict[str, str] = {}
 
     def sync(self) -> Dict[str, str]:
         """Ensure a proxy on every alive node; returns node_id -> addr."""
@@ -78,25 +123,50 @@ class ProxyManager:
                     lifetime="detached",
                     scheduling_strategy=NodeAffinitySchedulingStrategy(
                         node_id=node_id),
-                ).remote(self._controller_name, self._port)
+                ).remote(self._controller_name, self._port,
+                         grpc_port=self._grpc_port)
             ray_tpu.get(handle.ready.remote(), timeout=60)
             self._proxies[node_id] = handle
             self._addresses[node_id] = ray_tpu.get(handle.address.remote(),
                                                    timeout=30)
+            g = ray_tpu.get(handle.grpc_address.remote(), timeout=30)
+            if g is None and self._grpc_port is not None:
+                # Attached to a pre-existing HTTP-only actor (e.g. started
+                # by an earlier driver) while this manager wants gRPC:
+                # upgrade it in place instead of silently serving nothing.
+                g = ray_tpu.get(handle.ensure_grpc.remote(self._grpc_port),
+                                timeout=60)
+            if g:
+                self._grpc_addresses[node_id] = g
         for node_id in list(self._proxies):
             if node_id not in alive:
                 self._proxies.pop(node_id, None)
                 self._addresses.pop(node_id, None)
+                self._grpc_addresses.pop(node_id, None)
         return dict(self._addresses)
 
     def addresses(self) -> Dict[str, str]:
         return dict(self._addresses)
+
+    def grpc_addresses(self) -> Dict[str, str]:
+        return dict(self._grpc_addresses)
+
+    def enable_grpc(self, grpc_port: int = 0) -> Dict[str, str]:
+        """Upgrade an HTTP-only fleet in place: every live proxy actor
+        starts its gRPC ingress (``ensure_grpc``); new actors get it at
+        spawn. Returns node_id -> gRPC address."""
+        self._grpc_port = grpc_port
+        for node_id, handle in self._proxies.items():
+            self._grpc_addresses[node_id] = ray_tpu.get(
+                handle.ensure_grpc.remote(grpc_port), timeout=60)
+        return dict(self._grpc_addresses)
 
     def drain_node(self, node_id: str, timeout_s: float = 30.0) -> bool:
         """Scale-down: no new requests, in-flight finish, then the proxy
         exits. True iff fully drained within the timeout."""
         handle = self._proxies.pop(node_id, None)
         self._addresses.pop(node_id, None)
+        self._grpc_addresses.pop(node_id, None)
         if handle is None:
             return True
         drained = ray_tpu.get(handle.drain.remote(timeout_s),
