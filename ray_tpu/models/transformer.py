@@ -282,6 +282,77 @@ def make_block_fn(
     return block
 
 
+def make_tp_block_fn(config: TransformerConfig, mesh: Mesh,
+                     rules: ShardingRules):
+    """Per-DEVICE transformer block for use INSIDE ``shard_map`` (the
+    pipeline body): tensor parallelism and sequence parallelism are written
+    as explicit collectives instead of sharding constraints —
+
+    - megatron TP: q/k/v/up projections are column-parallel (weights arrive
+      with heads/mlp dims locally sliced), out/down projections are
+      row-parallel with a ``lax.psum`` over the tensor axis before the
+      (replicated) bias — the pattern §2.4 says the reference only reaches
+      by delegating to DeepSpeed;
+    - SP: ring attention over the seq axis (K/V blocks rotate via
+      ``ppermute``, online softmax — parallel.ring_attention), with RoPE
+      positions offset by the device's sequence block.
+
+    With tensor=1 and seq=1 this degrades to exactly the plain block body
+    (psum over a size-1 axis is identity; a 1-ring is dense attention), so
+    the pipeline uses ONE body for every composition."""
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    scale = 1.0 / c.head_dim ** 0.5
+    tensor_axis = rules.heads if isinstance(rules.heads, str) else None
+    seq_axis = rules.seq_act if isinstance(rules.seq_act, str) else None
+    tp = mesh.shape[tensor_axis] if tensor_axis in mesh.shape else 1
+    sp = mesh.shape[seq_axis] if seq_axis in mesh.shape else 1
+
+    from ray_tpu.parallel.ring_attention import _ring_attention_local
+
+    def attention(q, k, v):
+        if sp > 1:
+            return _ring_attention_local(
+                q, k, v, axis_name=seq_axis, axis_size=sp, causal=True,
+                scale=scale)
+        return _dense_attention(q, k, v, scale=scale)
+
+    def block(h, bp):
+        bp = jax.tree.map(cast, bp)
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("bld,dhk->blhk", x, bp["wq"],
+                       preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        kk = jnp.einsum("bld,dhk->blhk", x, bp["wk"],
+                        preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        vv = jnp.einsum("bld,dhk->blhk", x, bp["wv"],
+                        preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            off = (lax.axis_index(seq_axis) * h.shape[1]
+                   if sp > 1 else 0)
+            positions = off + jnp.arange(h.shape[1])
+            q = rope(q, positions)
+            kk = rope(kk, positions)
+        o = attention(q, kk, vv)
+        o = jnp.einsum("blhk,hkd->bld", o, bp["wo"],
+                       preferred_element_type=jnp.float32)
+        if tp > 1:
+            o = lax.psum(o, tensor_axis)  # row-parallel reduce
+        h = h + o.astype(c.dtype) + bp["bo"]
+
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = linear(x, bp["w_up"], bp["b_up"])  # column-parallel: local slice
+        u = gelu(u)
+        d = jnp.einsum("blf,fd->bld", u, bp["w_down"],
+                       preferred_element_type=jnp.float32)
+        if tp > 1:
+            d = lax.psum(d, tensor_axis)  # row-parallel reduce
+        # Bias in f32 then cast — same order as ops.layers.linear.
+        h = h + (d + bp["b_down"].astype(jnp.float32)).astype(c.dtype)
+        return h
+
+    return block
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -382,7 +453,10 @@ def pp_lm_loss(
     across pipe (identical inputs ⇒ identical math on every stage group);
     only the blocks hand activations stage-to-stage. Losses match the
     non-PP ``lm_loss`` exactly (same block body, same reduction)."""
+    from jax.sharding import PartitionSpec as P
+
     from ray_tpu.parallel.pipeline import make_pipeline
+    from ray_tpu.parallel.sharding import pytree_shardings
 
     c = config
     cast = lambda p: p.astype(c.dtype)
@@ -394,9 +468,9 @@ def pp_lm_loss(
                else (rules.batch,)):
         if ax is not None and ax in mesh.shape:
             dp *= mesh.shape[ax]
-    assert (B // num_microbatches) % dp == 0, (
-        f"microbatch {B // num_microbatches} must divide over the "
-        f"data-parallel degree {dp}")
+    assert B % dp == 0 and (B // dp) % num_microbatches == 0, (
+        f"per-device batch {B}/{dp} must split evenly into "
+        f"{num_microbatches} microbatches")
 
     # Explicit table all-gather before the lookup (see forward()): avoids
     # the partitioner's involuntary-remat fallback on sharded-table gather.
@@ -404,26 +478,50 @@ def pp_lm_loss(
     h = jnp.take(tbl, tokens, axis=0)
     if c.pos == "learned":
         h = h + cast(params["pos_embed"])[jnp.arange(L)]
+    h = constrain(h, mesh, rules, ("batch", "seq_act", None))
 
-    # Blocks must run WITHOUT global sharding constraints (per-device code
-    # inside shard_map) and with a local attention impl (dense/flash).
-    block = make_block_fn(c, None, None)
+    # The per-device block composes TP (psum on tensor) and SP (ring
+    # attention on seq) inside the pipeline's shard_map; weights enter
+    # tensor-sharded per their logical axes (embed replicated — the
+    # fsdp gather happens once at the shard_map boundary).
+    block = make_tp_block_fn(c, mesh, rules)
+    pp_rules = rules.update(embed=None)
+    param_specs = jax.tree.map(
+        pp_rules.mesh_axes,
+        logical_axes(c)["blocks"],
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x),
+    )
+    batch_axes = rules.batch
+    seq_ax = rules.seq_act if isinstance(rules.seq_act, str) else None
+    x_spec = P(batch_axes, None, seq_ax, None)
     pipeline = make_pipeline(
         lambda bp, x: block(x, bp),
         mesh,
         num_microbatches=num_microbatches,
         pipe_axis=rules.layers,
-        batch_axes=rules.batch,
+        batch_axes=batch_axes,
+        x_spec=x_spec,
+        param_specs=param_specs,
         remat=c.remat,
     )
     mb = B // num_microbatches
-    h = pipeline(params["blocks"], h.reshape(num_microbatches, mb, L, -1))
-    h = h.reshape(B, L, -1)
+    # Microbatch index on the TRAILING side of the split: a batch-sharded
+    # [B, ...] reshapes into [mb, M, ...] with zero data movement (each
+    # device's contiguous rows stay its own); the [M, mb, ...] layout
+    # would force an involuntary-remat repartition (pipeline docstring).
+    x4 = h.reshape(mb, num_microbatches, L, h.shape[-1])
+    x4 = jax.lax.with_sharding_constraint(
+        x4, jax.sharding.NamedSharding(mesh, x_spec))
+    h = pipeline(params["blocks"], x4)
+    h = h.reshape(B, L, h.shape[-1])
+    h = constrain(h, mesh, rules, ("batch", "seq_act", None))
 
     h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
     w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bld,dv->blv", h, cast(w_out),
                         preferred_element_type=jnp.float32).astype(c.dtype)
+    logits = constrain(logits, mesh, rules, ("batch", "seq_act", "vocab"))
     labels = jnp.where(
         batch.get("loss_mask", jnp.ones_like(tokens))[:, 1:] > 0,
         tokens[:, 1:],

@@ -14,11 +14,27 @@ pipeline.
 Schedule: classic GPipe fill-drain. For M microbatches on S stages the loop
 runs M + S - 1 ticks; at tick t stage 0 ingests microbatch t (if any) and
 stage S-1 emits microbatch t - (S - 1).
+
+Input layout: ``x`` is ``[microbatch, num_microbatches, ...]`` — microbatch
+members on the LEADING (batch-sharded) dim, the microbatch *index* trailing
+it. This ordering matters: reshaping a batch-dim-sharded ``[B, ...]``
+activation into ``[B/M, M, ...]`` splits each device's contiguous row block
+in place (pure relabeling, zero data movement), whereas the transposed
+``[M, B/M, ...]`` layout scatters every device's rows across microbatch
+slots — the SPMD partitioner can only realize that as replicate-then-
+repartition ("involuntary full rematerialization", a full activation
+all-gather per step). The body transposes to schedule order locally
+(device-local swapaxes — free of collectives).
+
+The per-layer body runs under ``shard_map`` over the FULL mesh, so it can
+compose tensor parallelism (``lax.psum`` over the tensor axis) and ring
+attention (``lax.ppermute`` over the seq axis) inside the pipeline —
+pipe×seq×tensor×(data/fsdp) in one jitted step.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,22 +49,32 @@ def make_pipeline(
     num_microbatches: int,
     pipe_axis: str = "pipe",
     batch_axes=("data", "fsdp"),
+    x_spec: Optional[P] = None,
+    param_specs=None,
     remat: bool = False,
 ):
     """Build a jittable, DIFFERENTIABLE pipelined forward pass.
 
-    ``layer_fn(layer_params, x) -> y`` is the per-LAYER computation;
-    activations must keep the input shape (transformer blocks qualify).
+    ``layer_fn(layer_params, x) -> y`` is the per-LAYER computation on
+    PER-DEVICE local blocks; activations must keep the input shape
+    (transformer blocks qualify). It may use mesh collectives (``psum`` on
+    the tensor axis, ``ppermute`` on the seq axis) — it runs inside the
+    pipeline's ``shard_map``.
 
     Arguments to the returned function:
     - ``layer_params``: pytree whose leaves have leading dim = total layers
       L (sharded on ``pipe_axis``; L must divide evenly into the stage
       count). Each stage scans its local L/S layers per tick.
-    - ``x``: [num_microbatches, microbatch, ...] input, replicated over pipe.
+      ``param_specs`` (optional pytree of PartitionSpec) shards the
+      remaining dims too (tensor-parallel weights); default ``P(pipe)``.
+    - ``x``: ``[microbatch, num_microbatches, ...]`` input (see module
+      docstring for why the microbatch index trails). ``x_spec`` overrides
+      the default ``P(batch_axes, None)`` — pass e.g.
+      ``P(batch_axes, None, "seq", None)`` for sequence-parallel
+      activations.
 
-    Returns [num_microbatches, microbatch, ...] outputs (replicated over
-    pipe). ``jax.grad`` through the result differentiates the whole
-    schedule.
+    Returns outputs in the same layout/sharding as ``x``. ``jax.grad``
+    through the result differentiates the whole schedule.
     """
     n_stages = mesh.shape[pipe_axis]
     ticks = num_microbatches + n_stages - 1
@@ -59,6 +85,10 @@ def make_pipeline(
         is_first = stage == 0
         is_last = stage == n_stages - 1
 
+        # Local reorder to schedule layout [num_micro, mb_local, ...]:
+        # a device-local transpose, no collectives.
+        xt = jnp.swapaxes(x, 0, 1)
+
         def apply_stage(inp):
             def one(h, lp):
                 return fn(lp, h), None
@@ -66,13 +96,13 @@ def make_pipeline(
             h, _ = lax.scan(one, inp, layer_params)
             return h
 
-        out0 = jnp.zeros_like(x)
-        carry0 = jnp.zeros(x.shape[1:], x.dtype)
+        out0 = jnp.zeros_like(xt)
+        carry0 = jnp.zeros(xt.shape[1:], xt.dtype)
 
         def tick(state, t):
             carry, out = state
             mb_index = jnp.clip(t, 0, num_microbatches - 1)
-            fresh = lax.dynamic_index_in_dim(x, mb_index, axis=0,
+            fresh = lax.dynamic_index_in_dim(xt, mb_index, axis=0,
                                              keepdims=False)
             inp = jnp.where(is_first, fresh, carry)
             y = apply_stage(inp)
@@ -91,15 +121,17 @@ def make_pipeline(
         (_, out), _ = lax.scan(tick, (carry0, out0), jnp.arange(ticks))
         # Output lives on the last stage only; psum replicates it (all other
         # stages contribute zeros).
-        return lax.psum(out, pipe_axis)
+        return jnp.swapaxes(lax.psum(out, pipe_axis), 0, 1)
 
-    param_spec = P(pipe_axis)
-    x_spec = P(None, batch_axes)
+    if param_specs is None:
+        param_specs = P(pipe_axis)
+    if x_spec is None:
+        x_spec = P(batch_axes, None)
 
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_spec, x_spec),
+        in_specs=(param_specs, x_spec),
         out_specs=x_spec,
         check_vma=False,
     )

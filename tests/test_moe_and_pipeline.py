@@ -96,13 +96,15 @@ class TestPipelineIntegration:
             "w": jnp.stack([jax.random.normal(k, (D, D)) * 0.5 for k in ks]),
             "b": jnp.zeros((n_stages, D)),
         }
-        x = jax.random.normal(jax.random.key(1), (n_micro, 4, D))
+        # Layout contract: [microbatch, num_microbatches, ...] (pipeline
+        # docstring — microbatch index trails the batch-sharded dim).
+        x = jax.random.normal(jax.random.key(1), (4, n_micro, D))
 
-        # sequential oracle
+        # sequential oracle (stage_fn broadcasts over leading dims)
         h = x
         for i in range(n_stages):
             p = {"w": stage_params["w"][i], "b": stage_params["b"][i]}
-            h = jax.vmap(lambda mb: stage_fn(p, mb))(h)
+            h = stage_fn(p, h)
 
         mesh = cpu_mesh(MeshSpec(pipe=4, data=2))
         pipeline = make_pipeline(stage_fn, mesh, num_microbatches=n_micro)
@@ -159,3 +161,48 @@ class TestPipelineTransformerTraining:
         np.testing.assert_allclose(pp_losses, oracle_losses, rtol=2e-4)
         # Training actually progressed.
         assert pp_losses[1] < pp_losses[0]
+
+    def test_pp_sp_tp_composed_matches_oracle(self):
+        """The full 3D composition in ONE jitted train step: blocks
+        pipelined over ``pipe``, ring attention over ``seq`` and megatron
+        psums over ``tensor`` INSIDE the pipeline shard_map. Losses must
+        track the plain data-parallel oracle."""
+        from ray_tpu.models import transformer as tf
+        from ray_tpu.models.training import make_train_step
+
+        cfg = tf.tiny(n_layers=2)
+        rules = ShardingRules()
+        tokens = np.asarray(
+            jax.random.randint(jax.random.key(3), (8, cfg.max_seq_len), 0,
+                               cfg.vocab_size, jnp.int32))
+        batch = {"tokens": jnp.asarray(tokens)}
+
+        def run(mesh, loss_fn):
+            bundle = make_train_step(
+                loss_fn=loss_fn,
+                init_params_fn=lambda k: tf.init_params(cfg, k),
+                logical_params=tf.logical_axes(cfg),
+                mesh=mesh,
+                rules=rules,
+                optimizer=optax.adamw(1e-3),
+            )
+            params, opt = bundle.init(jax.random.key(7))
+            losses = []
+            for _ in range(2):
+                params, opt, m = bundle.step(params, opt, batch)
+                losses.append(float(m["loss"]))
+            return losses
+
+        mesh3d = cpu_mesh(MeshSpec(pipe=2, seq=2, tensor=2))
+        l3d = run(
+            mesh3d,
+            lambda p, b: tf.pp_lm_loss(p, b, cfg, mesh=mesh3d, rules=rules,
+                                       num_microbatches=2),
+        )
+        oracle_mesh = cpu_mesh(MeshSpec(data=2))
+        lo = run(
+            oracle_mesh,
+            lambda p, b: tf.lm_loss(p, b, cfg, mesh=oracle_mesh, rules=rules),
+        )
+        np.testing.assert_allclose(l3d, lo, rtol=1e-3)
+        assert l3d[1] < l3d[0]

@@ -102,7 +102,9 @@ def test_pipeline_matches_serial():
     rng = np.random.default_rng(3)
     weights = jnp.asarray(rng.normal(size=(n_stages, dim, dim)) * 0.3, jnp.float32)
     biases = jnp.asarray(rng.normal(size=(n_stages, dim)) * 0.1, jnp.float32)
-    x = jnp.asarray(rng.normal(size=(n_mb, mb, dim)), jnp.float32)
+    # Layout contract: [microbatch, num_microbatches, ...] — the microbatch
+    # INDEX trails the batch-sharded dim (parallel.pipeline docstring).
+    x = jnp.asarray(rng.normal(size=(mb, n_mb, dim)), jnp.float32)
 
     def stage_fn(params, h):
         w, b = params
