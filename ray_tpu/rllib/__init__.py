@@ -10,8 +10,11 @@ from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
 from ray_tpu.rllib.impala import IMPALA, AggregatorActor, ImpalaConfig, ImpalaLearner, vtrace
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.offline import BC, MARWIL, BCConfig, MARWILConfig, episodes_to_dataset
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, nstep_columns
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec, spec_for_env
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
 
 __all__ = [
     "RLModule",
@@ -35,4 +38,15 @@ __all__ = [
     "DQNConfig",
     "DQNLearner",
     "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "nstep_columns",
+    "SAC",
+    "SACConfig",
+    "SACLearner",
+    "SACModule",
+    "BC",
+    "MARWIL",
+    "BCConfig",
+    "MARWILConfig",
+    "episodes_to_dataset",
 ]

@@ -67,7 +67,7 @@ class DQNLearner(Learner):
         self._updates = 0
 
         def td_targets(target_params, online_params, next_obs, rewards,
-                       terminateds):
+                       terminateds, discounts):
             q_next_t = self.module.forward_train(
                 target_params, next_obs)["action_dist_inputs"]
             if self.config.get("double_q", True):
@@ -79,10 +79,17 @@ class DQNLearner(Learner):
                 next_q = q_next_t[jnp.arange(q_next_t.shape[0]), best]
             else:
                 next_q = jnp.max(q_next_t, axis=-1)
-            gamma = self.config.get("gamma", 0.99)
-            return rewards + gamma * (1.0 - terminateds) * next_q
+            # Per-sample discount γ^s (n-step chains have varying length).
+            return rewards + discounts * (1.0 - terminateds) * next_q
 
         self._targets_fn = jax.jit(td_targets)
+
+        def td_errors(params, obs, actions, targets):
+            q = self.module.forward_train(params, obs)["action_dist_inputs"]
+            qa = q[jnp.arange(q.shape[0]), actions.astype(jnp.int32)]
+            return qa - targets
+
+        self._errors_fn = jax.jit(td_errors)
 
     def loss_fn(self, params, batch):
         q = self.module.forward_train(params, batch["obs"])["action_dist_inputs"]
@@ -91,18 +98,31 @@ class DQNLearner(Learner):
         # Huber (delta=1): quadratic near 0, linear in the tails.
         huber = jnp.where(jnp.abs(err) <= 1.0, 0.5 * err**2,
                           jnp.abs(err) - 0.5)
-        return jnp.mean(huber)
+        # PER importance weights (ones under uniform replay).
+        return jnp.mean(batch["weights"] * huber)
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        n = len(batch["rewards"])
+        discounts = batch.get(
+            "discounts",
+            np.full(n, self.config.get("gamma", 0.99), np.float32))
         targets = self._targets_fn(
             self.target_params, self.params,
             jnp.asarray(batch["next_obs"]), jnp.asarray(batch["rewards"]),
-            jnp.asarray(batch["terminateds"]))
+            jnp.asarray(batch["terminateds"]), jnp.asarray(discounts))
+        weights = batch.get("weights", np.ones(n, np.float32))
         metrics = super().update({
             "obs": batch["obs"],
             "actions": batch["actions"],
             "targets": np.asarray(targets),
+            "weights": weights,
         })
+        if "indices" in batch:
+            # |TD error| for PER priority refresh (post-update params) —
+            # skipped under uniform replay, where nothing would read it.
+            metrics["td_errors"] = np.asarray(self._errors_fn(
+                self.params, jnp.asarray(batch["obs"]),
+                jnp.asarray(batch["actions"]), targets))
         self._updates += 1
         if self._updates % self.config.get("target_update_freq", 100) == 0:
             self.target_params = jax.tree.map(lambda x: x, self.params)
@@ -138,6 +158,11 @@ class DQNConfig(AlgorithmConfigBase):
     lr: float = 1e-3
     grad_clip: float = 10.0
     double_q: bool = True
+    # Prioritized replay (the reference's DQN default) + n-step returns.
+    replay: str = "prioritized"  # "prioritized" | "uniform"
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    n_step: int = 1
     epsilon_initial: float = 1.0
     epsilon_final: float = 0.05
     epsilon_decay_timesteps: int = 5_000
@@ -169,7 +194,15 @@ class DQN:
             "grad_clip": config.grad_clip, "double_q": config.double_q,
             "target_update_freq": config.target_update_freq,
         }, seed=config.seed)
-        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        if config.replay == "prioritized":
+            from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, alpha=config.per_alpha,
+                beta=config.per_beta, seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity,
+                                       seed=config.seed)
 
         runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self._runners = [
@@ -196,28 +229,25 @@ class DQN:
         ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
         ray_tpu.get([r.set_exploration.remote(eps) for r in self._runners])
 
-    @staticmethod
-    def _to_transitions(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """[T, N] rollout columns -> flat (s, a, r, s', done) transitions.
+    def _to_transitions(self, sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """[T, N] rollout columns -> flat (s, a, R^(n), s_{t+n}, done, γ^s)
+        transitions via the shared n-step preprocessor (replay.py).
 
         gymnasium NEXT_STEP autoreset: obs[t+1] is the episode's FINAL obs
         when step t ended it (reset obs only appears one step later), so
         (obs[t], a[t], r[t], obs[t+1]) is a valid transition for both
         termination and truncation; the autoreset step itself
-        (valids==0) is junk and dropped."""
-        obs, acts = sample["obs"], sample["actions"]
-        T, N = acts.shape[0], acts.shape[1]
-        next_obs = np.concatenate(
-            [obs[1:], sample["bootstrap_obs"][None]], axis=0)
-        flat = {
-            "obs": obs.reshape((T * N,) + obs.shape[2:]),
-            "actions": acts.reshape(T * N),
-            "rewards": sample["rewards"].reshape(T * N),
-            "next_obs": next_obs.reshape((T * N,) + obs.shape[2:]),
-            "terminateds": sample["terminateds"].reshape(T * N),
-        }
-        keep = sample["valids"].reshape(T * N) > 0
-        return {k: v[keep] for k, v in flat.items()}
+        (valids==0) is junk, dropped here and treated as a chain break by
+        the n-step accumulation."""
+        from ray_tpu.rllib.replay import nstep_columns
+
+        cols = nstep_columns(
+            sample["obs"], sample["rewards"], sample["terminateds"],
+            sample["valids"], sample["bootstrap_obs"],
+            n_step=self.config.n_step, gamma=self.config.gamma)
+        keep = cols.pop("_keep")
+        cols["actions"] = sample["actions"].reshape(-1)[keep]
+        return cols
 
     # -- the Tune contract ---------------------------------------------------
     def train(self) -> Dict[str, Any]:
@@ -236,7 +266,12 @@ class DQN:
                 and len(self.buffer) >= cfg.train_batch_size):
             for _ in range(cfg.updates_per_iteration):
                 batch = self.buffer.sample(cfg.train_batch_size)
-                losses.append(self.learner.update(batch)["loss"])
+                m = self.learner.update(batch)
+                if "indices" in batch:
+                    # PER priority refresh from this step's |TD error|.
+                    self.buffer.update_priorities(batch["indices"],
+                                                  m["td_errors"])
+                losses.append(m["loss"])
                 self._updates += 1
         self._sync_runners()
 

@@ -27,6 +27,7 @@ class SingleAgentEnvRunner:
         num_envs: int = 1,
         seed: int = 0,
         spec: Optional[RLModuleSpec] = None,
+        module_factory: Optional[Callable[[RLModuleSpec], Any]] = None,
     ):
         import gymnasium as gym
 
@@ -37,7 +38,12 @@ class SingleAgentEnvRunner:
         probe = env_creator()
         self.spec = spec or spec_for_env(probe)
         probe.close()
-        self.module = RLModule(self.spec)
+        # Algorithms with non-actor-critic policies (SAC's tanh-squashed
+        # Gaussian) plug in their own module; the contract is
+        # ``init_params`` / ``sample_action(params, obs, key)`` /
+        # ``forward_inference`` (reference: RLModuleSpec.module_class).
+        self.module = (module_factory(self.spec) if module_factory
+                       else RLModule(self.spec))
         # Env-runner inference is tiny and latency-bound: pin it to host CPU
         # (committed args steer jit placement). The TPU belongs to learners —
         # shipping a 4-float CartPole obs across the interconnect per step

@@ -1,0 +1,431 @@
+"""SAC — soft actor-critic, the off-policy continuous-control family.
+
+Analog of the reference's ``rllib/algorithms/sac/sac.py`` (which subclasses
+DQN — ``sac.py:419``; here SAC shares DQN's machinery the same way: the
+prioritized replay buffer and n-step preprocessing from
+``ray_tpu.rllib.replay``, the env-runner actors, and the Tune-compatible
+``train()`` contract). Haarnoja et al. 2018: tanh-squashed Gaussian policy,
+twin Q networks with min-clipping, entropy temperature α auto-tuned against
+a target entropy. TPU-native shape: the WHOLE update (critic + actor + α +
+polyak target blend) is one jitted program; the replay/priority bookkeeping
+stays host-side numpy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm_config import AlgorithmConfigBase
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, nstep_columns
+from ray_tpu.rllib.rl_module import RLModuleSpec, spec_for_env
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(key, dims):
+    keys = jax.random.split(key, len(dims) - 1)
+    return [{"w": jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a),
+             "b": jnp.zeros((b,))}
+            for k, (a, b) in zip(keys, zip(dims[:-1], dims[1:]))]
+
+
+def _mlp(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = params[-1]
+    return x @ last["w"] + last["b"]
+
+
+class SACModule:
+    """Policy + twin Q. Satisfies the env-runner module contract
+    (``init_params`` / ``sample_action`` / ``forward_inference``)."""
+
+    def __init__(self, spec: RLModuleSpec,
+                 action_low: np.ndarray, action_high: np.ndarray,
+                 hidden: Tuple[int, ...] = (256, 256)):
+        assert not spec.discrete, "SAC requires a continuous action space"
+        self.spec = spec
+        self.hidden = hidden
+        self._scale = jnp.asarray((action_high - action_low) / 2.0)
+        self._center = jnp.asarray((action_high + action_low) / 2.0)
+
+    def init_params(self, key: jax.Array) -> Dict:
+        s = self.spec
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            "pi": _mlp_init(kp, (s.observation_dim,) + self.hidden
+                            + (2 * s.action_dim,)),
+            "q1": _mlp_init(k1, (s.observation_dim + s.action_dim,)
+                            + self.hidden + (1,)),
+            "q2": _mlp_init(k2, (s.observation_dim + s.action_dim,)
+                            + self.hidden + (1,)),
+        }
+
+    # -- policy ---------------------------------------------------------------
+
+    def _pi_dist(self, pi_params, obs):
+        out = _mlp(pi_params, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        return mean, log_std
+
+    def pi_sample(self, pi_params, obs, key):
+        """(env_action, logp, squashed_unit_action) — reparameterized."""
+        mean, log_std = self._pi_dist(pi_params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        a = jnp.tanh(pre)
+        # logp under the squashed distribution (tanh change of variables).
+        logp = jnp.sum(
+            -0.5 * eps**2 - log_std - 0.5 * jnp.log(2 * jnp.pi)
+            - jnp.log(1.0 - a**2 + 1e-6),
+            axis=-1)
+        return a * self._scale + self._center, logp, a
+
+    def q_value(self, q_params, obs, env_action):
+        # Q nets see UNIT actions: normalize the env-scaled input.
+        a = (env_action - self._center) / self._scale
+        return _mlp(q_params, jnp.concatenate([obs, a], axis=-1))[..., 0]
+
+    # -- env-runner contract --------------------------------------------------
+
+    def sample_action(self, params, obs, key):
+        act, logp, _ = self.pi_sample(params["pi"], obs, key)
+        return act, logp, jnp.zeros(obs.shape[0])
+
+    def forward_inference(self, params, obs):
+        mean, _ = self._pi_dist(params["pi"], obs)
+        return {"action_dist_inputs": mean,
+                "vf_preds": jnp.zeros(obs.shape[0])}
+
+    forward_train = forward_inference
+
+
+class SACLearner:
+    """One jitted program per update: critic → actor → α → polyak."""
+
+    def __init__(self, module: SACModule, config: Dict[str, Any],
+                 seed: int = 0):
+        self.module = module
+        self.config = dict(config)
+        self.device = jax.local_devices(backend="cpu")[0]
+        key = jax.random.key(seed)
+        self.params = jax.device_put(module.init_params(key), self.device)
+        self.target_q = jax.device_put(
+            {"q1": self.params["q1"], "q2": self.params["q2"]}, self.device)
+        self.log_alpha = jnp.asarray(
+            float(np.log(self.config.get("initial_alpha", 1.0))))
+        act_dim = module.spec.action_dim
+        self.target_entropy = float(
+            self.config.get("target_entropy", -act_dim))
+
+        lr = self.config.get("lr", 3e-4)
+        self.pi_opt = optax.adam(self.config.get("actor_lr", lr))
+        self.q_opt = optax.adam(self.config.get("critic_lr", lr))
+        self.a_opt = optax.adam(self.config.get("alpha_lr", lr))
+        self.pi_state = self.pi_opt.init(self.params["pi"])
+        self.q_state = self.q_opt.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.a_state = self.a_opt.init(self.log_alpha)
+        self._key = jax.random.key(seed + 1)
+        self._step_fn = jax.jit(self._step)
+        self._updates = 0
+
+    def _step(self, params, target_q, log_alpha, pi_state, q_state, a_state,
+              batch, key):
+        m = self.module
+        tau = self.config.get("tau", 0.005)
+        alpha = jnp.exp(log_alpha)
+        k1, k2 = jax.random.split(key)
+
+        # -- critic: y = r + γ^s (1-d) [min Q_t(s', a') - α log π(a'|s')]
+        a2, logp2, _ = m.pi_sample(params["pi"], batch["next_obs"], k1)
+        qt = jnp.minimum(m.q_value(target_q["q1"], batch["next_obs"], a2),
+                         m.q_value(target_q["q2"], batch["next_obs"], a2))
+        y = (batch["rewards"]
+             + batch["discounts"] * (1.0 - batch["terminateds"])
+             * (qt - alpha * logp2))
+        y = jax.lax.stop_gradient(y)
+
+        def q_loss_fn(qp):
+            q1 = m.q_value(qp["q1"], batch["obs"], batch["actions"])
+            q2 = m.q_value(qp["q2"], batch["obs"], batch["actions"])
+            w = batch["weights"]
+            loss = jnp.mean(w * ((q1 - y) ** 2 + (q2 - y) ** 2))
+            return loss, q1 - y
+
+        qp = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, td_err), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True)(qp)
+        q_upd, q_state = self.q_opt.update(q_grads, q_state, qp)
+        qp = optax.apply_updates(qp, q_upd)
+        params = dict(params, q1=qp["q1"], q2=qp["q2"])
+
+        # -- actor: max E[min Q(s, a_π) - α log π]
+        def pi_loss_fn(pp):
+            a_pi, logp_pi, _ = m.pi_sample(pp, batch["obs"], k2)
+            q_pi = jnp.minimum(m.q_value(params["q1"], batch["obs"], a_pi),
+                               m.q_value(params["q2"], batch["obs"], a_pi))
+            return jnp.mean(alpha * logp_pi - q_pi), logp_pi
+
+        (pi_loss, logp_pi), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True)(params["pi"])
+        pi_upd, pi_state = self.pi_opt.update(pi_grads, pi_state,
+                                              params["pi"])
+        params = dict(params, pi=optax.apply_updates(params["pi"], pi_upd))
+
+        # -- temperature: drive E[log π] toward -target_entropy
+        def a_loss_fn(la):
+            return -jnp.mean(
+                la * (jax.lax.stop_gradient(logp_pi) + self.target_entropy))
+
+        a_loss, a_grad = jax.value_and_grad(a_loss_fn)(log_alpha)
+        a_upd, a_state = self.a_opt.update(a_grad, a_state, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, a_upd)
+
+        # -- polyak target blend
+        target_q = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                target_q,
+                                {"q1": params["q1"], "q2": params["q2"]})
+        metrics = {"q_loss": q_loss, "pi_loss": pi_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -jnp.mean(logp_pi)}
+        return params, target_q, log_alpha, pi_state, q_state, a_state, \
+            td_err, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        n = len(batch["rewards"])
+        jbatch = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.float32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "terminateds": jnp.asarray(batch["terminateds"], jnp.float32),
+            "discounts": jnp.asarray(batch.get(
+                "discounts",
+                np.full(n, self.config.get("gamma", 0.99), np.float32))),
+            "weights": jnp.asarray(batch.get(
+                "weights", np.ones(n, np.float32))),
+        }
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.target_q, self.log_alpha, self.pi_state,
+         self.q_state, self.a_state, td_err, metrics) = self._step_fn(
+            self.params, self.target_q, self.log_alpha, self.pi_state,
+            self.q_state, self.a_state, jbatch, sub)
+        self._updates += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        out["loss"] = out["q_loss"]
+        out["td_errors"] = np.asarray(td_err)
+        return out
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    @staticmethod
+    def _np_tree(tree):
+        return jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.ndarray))
+            else x, tree)
+
+    @staticmethod
+    def _jnp_tree(tree):
+        return jax.tree.map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+            tree)
+
+    def get_state(self) -> Dict:
+        # Full continuation state: all three optimizer moments + the policy
+        # RNG key — restore must resume the run, not just the weights
+        # (same contract as Learner.get_state, learner.py:78).
+        return {
+            "params": self._np_tree(self.params),
+            "target_q": self._np_tree(self.target_q),
+            "log_alpha": np.asarray(self.log_alpha),
+            "pi_state": self._np_tree(self.pi_state),
+            "q_state": self._np_tree(self.q_state),
+            "a_state": self._np_tree(self.a_state),
+            "rng_key": np.asarray(jax.random.key_data(self._key)),
+            "updates": self._updates,
+        }
+
+    def set_state(self, state: Dict) -> bool:
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.target_q = jax.tree.map(jnp.asarray, state["target_q"])
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+        if "pi_state" in state:
+            self.pi_state = self._jnp_tree(state["pi_state"])
+            self.q_state = self._jnp_tree(state["q_state"])
+            self.a_state = self._jnp_tree(state["a_state"])
+        if "rng_key" in state:
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["rng_key"]))
+        self._updates = int(state.get("updates", 0))
+        return True
+
+
+@dataclass
+class SACConfig(AlgorithmConfigBase):
+    env: Optional[Callable[[], Any]] = None
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 1
+    rollout_fragment_length: int = 64
+    buffer_capacity: int = 100_000
+    train_batch_size: int = 256
+    num_steps_sampled_before_learning: int = 1_000
+    updates_per_iteration: int = 64
+    gamma: float = 0.99
+    lr: float = 3e-4
+    tau: float = 0.005
+    initial_alpha: float = 1.0
+    target_entropy: Optional[float] = None  # default -action_dim
+    replay: str = "prioritized"
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    n_step: int = 1
+    hidden: Tuple[int, ...] = (256, 256)
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """Tune-compatible train() contract (mirrors DQN — the reference's SAC
+    subclasses DQN for exactly this shared shape)."""
+
+    def __init__(self, config: SACConfig):
+        assert config.env is not None, "config.environment(env_creator) required"
+        self.config = config
+        probe = config.env()
+        self.spec = spec_for_env(probe)
+        low = np.asarray(probe.action_space.low, np.float32)
+        high = np.asarray(probe.action_space.high, np.float32)
+        probe.close()
+        assert not self.spec.discrete, "SAC requires a continuous action space"
+
+        factory = lambda spec: SACModule(spec, low, high,
+                                         hidden=tuple(config.hidden))
+        self.module = factory(self.spec)
+        lcfg = {"lr": config.lr, "gamma": config.gamma, "tau": config.tau,
+                "initial_alpha": config.initial_alpha}
+        if config.target_entropy is not None:
+            lcfg["target_entropy"] = config.target_entropy
+        self.learner = SACLearner(self.module, lcfg, seed=config.seed)
+
+        if config.replay == "prioritized":
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, alpha=config.per_alpha,
+                beta=config.per_beta, seed=config.seed)
+        else:
+            from ray_tpu.rllib.dqn import ReplayBuffer
+
+            self.buffer = ReplayBuffer(config.buffer_capacity,
+                                       seed=config.seed)
+
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            runner_cls.remote(
+                config.env, num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, spec=self.spec,
+                module_factory=factory,
+            )
+            for i in range(max(1, config.num_env_runners))
+        ]
+        self._timesteps = 0
+        self._iteration = 0
+        self._updates = 0
+        self._sync_runners()
+
+    def _sync_runners(self) -> None:
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
+
+    def _to_transitions(self, sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        cfg = self.config
+        cols = nstep_columns(
+            sample["obs"], sample["rewards"], sample["terminateds"],
+            sample["valids"], sample["bootstrap_obs"],
+            n_step=cfg.n_step, gamma=cfg.gamma)
+        keep = cols.pop("_keep")
+        acts = sample["actions"]
+        cols["actions"] = acts.reshape((-1,) + acts.shape[2:])[keep]
+        return cols
+
+    # -- the Tune contract ---------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        samples = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length)
+             for r in self._runners])
+        for s in samples:
+            trans = self._to_transitions(s)
+            self.buffer.add_batch(trans)
+            self._timesteps += len(trans["rewards"])
+
+        q_losses, ent = [], []
+        if (len(self.buffer) >= cfg.num_steps_sampled_before_learning
+                and len(self.buffer) >= cfg.train_batch_size):
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                m = self.learner.update(batch)
+                if "indices" in batch:
+                    self.buffer.update_priorities(batch["indices"],
+                                                  m["td_errors"])
+                q_losses.append(m["q_loss"])
+                ent.append(m["entropy"])
+                self._updates += 1
+        self._sync_runners()
+
+        self._iteration += 1
+        metrics = ray_tpu.get([r.get_metrics.remote() for r in self._runners])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["num_episodes"] > 0]
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "loss": float(np.mean(q_losses)) if q_losses else float("nan"),
+            "entropy": float(np.mean(ent)) if ent else float("nan"),
+            "alpha": float(np.exp(float(np.asarray(self.learner.log_alpha)))),
+            "buffer_size": len(self.buffer),
+            "num_updates": self._updates,
+            "time_total_s": dt,
+        }
+
+    def save(self, path: str) -> str:
+        from ray_tpu.train.checkpoint import save_pytree
+
+        save_pytree({"state": self.learner.get_state(),
+                     "iteration": self._iteration,
+                     "timesteps": self._timesteps}, path)
+        return path
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import load_pytree
+
+        data = load_pytree(path)
+        self.learner.set_state(data["state"])
+        self._iteration = int(data["iteration"])
+        self._timesteps = int(data["timesteps"])
+        self._sync_runners()
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
