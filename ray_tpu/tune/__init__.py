@@ -11,13 +11,16 @@ from ray_tpu.tune.experiment import Trial, TrialStatus
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    ConcurrencyLimiter,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -47,6 +50,9 @@ __all__ = [
     "PopulationBasedTraining",
     "Searcher",
     "BasicVariantGenerator",
+    "TPESearcher",
+    "ConcurrencyLimiter",
+    "HyperBandScheduler",
     "uniform",
     "loguniform",
     "quniform",

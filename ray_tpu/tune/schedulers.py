@@ -102,6 +102,85 @@ class ASHAScheduler(TrialScheduler):
         return decision
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand (reference: ``tune/schedulers/hyperband.py``): trials are
+    assigned round-robin to ``s_max + 1`` brackets; bracket ``s`` gives its
+    trials an initial budget of ``max_t * eta**-s`` iterations, then runs
+    successive halving — at each rung only the top ``1/eta`` of the
+    bracket's scores continue. Brackets with small initial budgets explore
+    many configs cheaply; the ``s=0`` bracket runs few configs to
+    ``max_t``. Halving decisions are asynchronous (a trial is judged
+    against the scores recorded at its rung so far — the ASHA relaxation),
+    which avoids the pause/resume machinery of the strictly synchronous
+    variant while keeping the bracketed exploration/exploitation spread
+    that distinguishes HyperBand from plain ASHA's single bracket."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # Integer repeated division, not int(log/log): float error truncates
+        # exact powers (log(243)/log(3) = 4.999... -> 4, losing a bracket).
+        s_max, t = 0, max_t
+        while t >= reduction_factor:
+            t /= reduction_factor
+            s_max += 1
+        self.s_max = s_max
+        # bracket s → ascending rung milestones starting at max_t * eta^-s
+        self._bracket_milestones: List[List[int]] = []
+        for s in range(self.s_max + 1):
+            r0 = max_t * reduction_factor ** (-s)
+            rungs = [int(round(r0 * reduction_factor ** i))
+                     for i in range(s + 1)
+                     if r0 * reduction_factor ** i < max_t]
+            self._bracket_milestones.append(sorted(set(rungs)) or [max_t])
+        self._next_bracket = 0
+        self._trial_bracket: Dict[str, int] = {}
+        self._trial_rung: Dict[str, int] = defaultdict(int)
+        # (bracket, milestone) → scores recorded there
+        self._rung_scores: Dict[tuple, List[float]] = defaultdict(list)
+
+    def _bracket_of(self, trial_id: str) -> int:
+        b = self._trial_bracket.get(trial_id)
+        if b is None:
+            # Round-robin assignment, large-s (cheap, exploratory) first.
+            b = self.s_max - (self._next_bracket % (self.s_max + 1))
+            self._next_bracket += 1
+            self._trial_bracket[trial_id] = b
+        return b
+
+    def on_trial_result(self, trial: "Trial", result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return self.STOP
+        bracket = self._bracket_of(trial.trial_id)
+        milestones = self._bracket_milestones[bracket]
+        score = self._score(result)
+        decision = self.CONTINUE
+        i = self._trial_rung[trial.trial_id]
+        while i < len(milestones) and t >= milestones[i]:
+            rung = (bracket, milestones[i])
+            scores = self._rung_scores[rung]
+            scores.append(score)
+            k = max(1, int(len(scores) / self.eta))
+            cutoff = sorted(scores, reverse=True)[k - 1]
+            if score < cutoff:
+                decision = self.STOP
+            i += 1
+        self._trial_rung[trial.trial_id] = i
+        return decision
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose best score is below the median of running averages
     (reference: ``tune/schedulers/median_stopping_rule.py``)."""

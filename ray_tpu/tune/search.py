@@ -132,7 +132,20 @@ class SampleFrom(Domain):
 # ---------------------------------------------------------------------------
 
 class Searcher:
-    """Pluggable search algorithm (reference: ``tune/search/searcher.py``)."""
+    """Pluggable search algorithm (reference: ``tune/search/searcher.py``).
+
+    ``suggest`` returns a config dict, ``None`` when the search is
+    exhausted, or :data:`Searcher.DEFER` when it cannot suggest *right now*
+    (e.g. a ConcurrencyLimiter at capacity, or a sequential model-based
+    searcher waiting for results) — the controller retries later.
+    """
+
+    DEFER = object()
+
+    # Sequential searchers (model-based: each suggestion should see prior
+    # results) are suggested LAZILY by the controller as slots free up,
+    # instead of having every config pre-generated before the first result.
+    sequential = False
 
     def __init__(self, metric: Optional[str] = None, mode: str = "max"):
         self.metric = metric
@@ -242,3 +255,222 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._next]
         self._next += 1
         return cfg
+
+
+# ---------------------------------------------------------------------------
+# Model-based search: TPE
+# ---------------------------------------------------------------------------
+
+def _flatten_domains(space: Dict, prefix: Tuple = ()) -> List[Tuple[Tuple, Any]]:
+    out: List[Tuple[Tuple, Any]] = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            out.extend(_flatten_domains(v, path))
+        else:
+            out.append((path, v))
+    return out
+
+
+def _get(config: Dict, path: Tuple) -> Any:
+    node = config
+    for k in path:
+        node = node[k]
+    return node
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator — the native model-based searcher
+    (role of the reference's optuna/hyperopt integrations,
+    ``python/ray/tune/search/optuna/optuna_search.py`` — implemented here
+    rather than wrapped since the image carries neither library).
+
+    Standard TPE (Bergstra et al., NeurIPS 2011): observations split into a
+    good set (top ``gamma`` quantile by the objective) and a bad set; each
+    dimension models l(x) (KDE over good values) and g(x) (over bad);
+    candidates are drawn from l and scored by the density ratio l/g —
+    maximizing it is equivalent to maximizing expected improvement.
+    Dimensions are modeled independently (the classic simplification).
+
+    Numeric domains use truncated Gaussian KDEs (log-space for
+    ``loguniform``); ``choice``/``randint`` use smoothed categorical
+    frequencies. ``grid_search`` / ``sample_from`` are not model-able —
+    use the BasicVariantGenerator for those spaces.
+    """
+
+    sequential = True
+
+    def __init__(self, space: Dict, *, metric: Optional[str] = None,
+                 mode: str = "max", n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode)
+        self.space = space
+        self.dims = _flatten_domains(space)
+        for path, dom in self.dims:
+            if isinstance(dom, (GridSearch, SampleFrom)):
+                raise ValueError(
+                    f"TPESearcher cannot model {type(dom).__name__} at "
+                    f"{'.'.join(path)}; use BasicVariantGenerator")
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._live: Dict[str, Dict] = {}     # trial_id -> config
+        self._obs: List[Tuple[Dict, float]] = []  # (config, score-to-MAXIMIZE)
+
+    # -- observation plumbing -------------------------------------------------
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        v = float(result[self.metric])
+        self._obs.append((cfg, v if self.mode == "max" else -v))
+
+    # -- modeling -------------------------------------------------------------
+
+    def _split(self) -> Tuple[List[Dict], List[Dict]]:
+        ranked = sorted(self._obs, key=lambda cv: cv[1], reverse=True)
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        return good, bad
+
+    @staticmethod
+    def _kde_logpdf(x: float, centers: List[float], bw: float,
+                    lo: float, hi: float) -> float:
+        # Mixture of Gaussians at the observed values, floor-mixed with the
+        # uniform prior so unexplored regions keep non-zero mass.
+        p_prior = 1.0 / max(hi - lo, 1e-12)
+        p = 0.0
+        for c in centers:
+            z = (x - c) / bw
+            p += math.exp(-0.5 * z * z) / (bw * 2.5066282746310002)
+        p = p / len(centers) if centers else 0.0
+        return math.log(0.8 * p + 0.2 * p_prior + 1e-300)
+
+    def _numeric_axis(self, dom, good_vals, bad_vals):
+        """Sample candidates from l, score by log l - log g; returns the
+        best candidate in the ORIGINAL domain units."""
+        logspace = isinstance(dom, LogUniform)
+        if logspace:
+            f = lambda v: math.log(v, dom.base)
+            lo, hi = f(dom.low), f(dom.high)
+            gvals = [f(v) for v in good_vals]
+            bvals = [f(v) for v in bad_vals]
+        else:
+            lo, hi = float(dom.low), float(dom.high)
+            gvals = [float(v) for v in good_vals]
+            bvals = [float(v) for v in bad_vals]
+        span = max(hi - lo, 1e-12)
+        bw_g = max(span / max(len(gvals), 1) ** 0.5, span * 0.05)
+        bw_b = max(span / max(len(bvals), 1) ** 0.5, span * 0.05)
+
+        best_x, best_score = None, -float("inf")
+        for _ in range(self.n_candidates):
+            if gvals and self.rng.random() < 0.8:
+                c = self.rng.choice(gvals)
+                x = min(max(self.rng.gauss(c, bw_g), lo), hi)
+            else:
+                x = self.rng.uniform(lo, hi)
+            s = (self._kde_logpdf(x, gvals, bw_g, lo, hi)
+                 - self._kde_logpdf(x, bvals, bw_b, lo, hi))
+            if s > best_score:
+                best_x, best_score = x, s
+        v = dom.base ** best_x if logspace else best_x
+        if isinstance(dom, QUniform):
+            v = round(v / dom.q) * dom.q
+        return v
+
+    def _categorical_axis(self, categories, good_vals, bad_vals):
+        def probs(vals):
+            counts = {i: 1.0 for i in range(len(categories))}  # +1 smoothing
+            for v in vals:
+                try:
+                    counts[categories.index(v)] += 1.0
+                except ValueError:
+                    pass
+            total = sum(counts.values())
+            return [counts[i] / total for i in range(len(categories))]
+
+        pg, pb = probs(good_vals), probs(bad_vals)
+        scores = [pg[i] / pb[i] for i in range(len(categories))]
+        # Sample ∝ l, then take the density-ratio argmax among candidates.
+        best_i = max(range(len(categories)), key=lambda i: scores[i])
+        return categories[best_i]
+
+    def _model_suggest(self) -> Dict:
+        good, bad = self._split()
+        cfg: Dict = {}
+        for path, dom in self.dims:
+            gv = [_get(c, path) for c in good]
+            bv = [_get(c, path) for c in bad]
+            if isinstance(dom, Choice):
+                val = self._categorical_axis(dom.categories, gv, bv)
+            elif isinstance(dom, Randint):
+                val = int(round(self._numeric_axis(
+                    Uniform(dom.low, dom.high - 1), gv, bv)))
+            elif isinstance(dom, (Uniform, LogUniform, QUniform)):
+                val = self._numeric_axis(dom, gv, bv)
+            elif isinstance(dom, RandnDomain):
+                # Unbounded: approximate with a wide uniform around the data.
+                allv = [float(v) for v in gv + bv] or [dom.mean]
+                lo = min(allv) - 3 * dom.sd
+                hi = max(allv) + 3 * dom.sd
+                val = self._numeric_axis(Uniform(lo, hi), gv, bv)
+            elif isinstance(dom, Domain):
+                val = dom.sample(self.rng)
+            else:
+                val = dom  # constant
+            _assign(cfg, path, val)
+        return cfg
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._obs) < self.n_initial:
+            cfg = _resolve(self.space, self.rng, {})
+        else:
+            cfg = self._model_suggest()
+        self._live[trial_id] = cfg
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps how many of a searcher's suggestions are unfinished at once
+    (reference: ``tune/search/concurrency_limiter.py``) — a sequential
+    model-based searcher under a limiter of 1 sees every result before its
+    next suggestion even when the cluster could run more trials."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    # metric/mode assignments made by the Tuner must reach the inner searcher.
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if name in ("metric", "mode") and "searcher" in self.__dict__:
+            setattr(self.searcher, name, value)
+
+    @property
+    def sequential(self):  # type: ignore[override]
+        return True
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return Searcher.DEFER
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg is not Searcher.DEFER:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result=result, error=error)

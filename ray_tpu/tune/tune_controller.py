@@ -114,6 +114,7 @@ class TuneController:
         max_concurrent: Optional[int] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         searcher=None,
+        num_samples: int = 0,  # lazy-suggestion budget (sequential searchers)
         experiment_state=None,  # ExperimentState for periodic snapshots
         experiment_meta: Optional[Dict[str, Any]] = None,
     ):
@@ -130,6 +131,15 @@ class TuneController:
         self.max_concurrent = max_concurrent or 8
         self.resources_per_trial = resources_per_trial or {"CPU": 1}
         self.searcher = searcher
+        # Sequential (model-based) searchers are consulted LAZILY: trials
+        # are created as slots free up, so each suggestion sees every prior
+        # completion (reference: TuneController asks the SearchGenerator for
+        # the next trial inside the step loop, not up front).
+        self.lazy_suggest = bool(searcher is not None
+                                 and getattr(searcher, "sequential", False))
+        self.num_samples = num_samples
+        self._suggested = len(trials)
+        self._search_exhausted = False
         self._runners: Dict[str, Any] = {}
         self._run_refs: Dict[str, Any] = {}
         self._collector = None
@@ -190,7 +200,33 @@ class TuneController:
                 trial = restarting.pop(0) if restarting else pending.pop(0)
                 self._launch(trial)
 
-            if not self._runners and not pending and not restarting:
+            # Lazy model-based suggestion: fill remaining slots one trial at
+            # a time so each suggest() call sees all completions so far.
+            while (self.lazy_suggest and not self._search_exhausted
+                   and self._suggested < self.num_samples
+                   and len(self._runners) < self.max_concurrent):
+                from ray_tpu.tune.search import Searcher
+
+                trial = Trial(config={})
+                cfg = self.searcher.suggest(trial.trial_id)
+                if cfg is None:
+                    self._search_exhausted = True
+                    break
+                if cfg is Searcher.DEFER:
+                    if not self._runners and not pending and not restarting:
+                        # Nothing running that could unblock the searcher —
+                        # treat as exhausted instead of spinning forever.
+                        self._search_exhausted = True
+                    break
+                trial.config = cfg
+                self._suggested += 1
+                self.trials.append(trial)
+                by_id[trial.trial_id] = trial
+                self._launch(trial)
+
+            lazy_more = (self.lazy_suggest and not self._search_exhausted
+                         and self._suggested < self.num_samples)
+            if not self._runners and not pending and not restarting and not lazy_more:
                 break
 
             results, done = ray_tpu.get(self._collector.drain.remote())

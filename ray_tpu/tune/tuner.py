@@ -162,6 +162,7 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         searcher = tc.search_alg
+        lazy = False
         if self._restored_trials is not None:
             trials = self._restored_trials
         else:
@@ -174,14 +175,18 @@ class Tuner:
                 searcher.metric = tc.metric
                 searcher.mode = tc.mode
 
+            # Sequential (model-based) searchers suggest lazily inside the
+            # controller loop — each suggestion sees prior results.
+            lazy = getattr(searcher, "sequential", False)
             trials = []
-            for _ in range(n_trials):
-                t = Trial(config={})
-                cfg = searcher.suggest(t.trial_id)
-                if cfg is None:
-                    break
-                t.config = cfg
-                trials.append(t)
+            if not lazy:
+                for _ in range(n_trials):
+                    t = Trial(config={})
+                    cfg = searcher.suggest(t.trial_id)
+                    if cfg is None:
+                        break
+                    t.config = cfg
+                    trials.append(t)
 
         exp_state = None
         exp_meta = {}
@@ -214,11 +219,12 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             resources_per_trial=self.resources_per_trial,
             searcher=searcher if not isinstance(searcher, BasicVariantGenerator) else None,
+            num_samples=tc.num_samples,
             experiment_state=exp_state,
             experiment_meta=exp_meta,
         )
         controller.run()
-        return ResultGrid(trials, tc.metric, tc.mode)
+        return ResultGrid(controller.trials, tc.metric, tc.mode)
 
 
 def run(
