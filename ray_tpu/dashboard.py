@@ -59,6 +59,11 @@ class Dashboard:
             "/api/placement_groups", self._json(lambda: _state().list_placement_groups())
         )
         app.router.add_get("/api/node_stats", self._json(_node_stats))
+        # Log viewer + task-event feed (reference:
+        # dashboard/modules/log/log_manager.py, modules/event/) over the
+        # existing GCS log aggregation and task-event pipeline.
+        app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/events", self._json(_task_event_feed))
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/timeline", self._timeline)
 
@@ -88,6 +93,40 @@ class Dashboard:
 
         return handler
 
+    async def _logs(self, request):
+        """Aggregated worker logs from the GCS "logs" pubsub channel.
+
+        ``?cursor=N`` resumes from an absolute message index (the client
+        stores the returned ``cursor`` and polls); ``?node=<hex>`` and
+        ``?worker=<name>`` filter; ``?timeout=S`` long-polls up to 25s.
+        """
+        from aiohttp import web
+
+        cursor = int(request.query.get("cursor", 0))
+        timeout = min(25.0, float(request.query.get("timeout", 0)))
+        node = request.query.get("node")
+        worker = request.query.get("worker")
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.core.runtime import get_runtime
+
+            end, batches = get_runtime().gcs.poll_channel(
+                "logs", cursor, timeout)
+            out = []
+            for batch in batches:
+                for entry in batch:
+                    if node and not entry.get("node_id", "").startswith(node):
+                        continue
+                    if worker and worker not in entry.get("worker", ""):
+                        continue
+                    out.append(entry)
+            return {"cursor": end, "batches": out}
+
+        data = await loop.run_in_executor(None, fetch)
+        return web.Response(text=json.dumps(data),
+                            content_type="application/json")
+
     async def _metrics(self, request):
         from aiohttp import web
 
@@ -114,6 +153,28 @@ def _state():
     from ray_tpu.util import state
 
     return state
+
+
+def _task_event_feed(limit: int = 500):
+    """Most recent task/span events from the GCS task-event store
+    (``gcs_task_manager.cc`` analog), newest first."""
+    from ray_tpu.core.runtime import get_runtime
+
+    events = get_runtime().gcs.task_events()
+    out = []
+    for e in events[-limit:][::-1]:
+        out.append({
+            "ts": e.get("time") or e.get("ts") or "",
+            "kind": e.get("state", e.get("kind", "event")),
+            "name": e.get("name", ""),
+            "task_id": str(e.get("task_id", ""))[-16:],
+            "node": str(e.get("node_id", ""))[:12],
+            "duration": e.get("duration"),
+            "detail": {k: v for k, v in e.items()
+                       if k not in ("time", "ts", "state", "kind", "name",
+                                    "task_id", "node_id", "duration")},
+        })
+    return out
 
 
 def _node_stats():
@@ -169,7 +230,9 @@ const TABS = {
   Overview: renderOverview, Nodes: renderNodes, Actors: mkTable('/api/actors'),
   Tasks: mkTable('/api/tasks'), Jobs: mkTable('/api/jobs'),
   'Placement groups': mkTable('/api/placement_groups'),
+  Logs: renderLogs, Events: renderEvents,
 };
+let logCursor = 0, logLines = [];
 let active = 'Overview';
 const nav = document.getElementById('nav');
 Object.keys(TABS).forEach(name => {
@@ -216,6 +279,28 @@ async function renderNodes(){
       ` <span class=muted>${(n.shm_bytes/1048576).toFixed(1)}MB</span>` : '-',
     spilled: n.spilled_objects??'-',
     resources: JSON.stringify(n.resources||{}),
+  })));
+}
+async function renderLogs(){
+  const d = await getJSON('/api/logs?cursor=' + logCursor);
+  logCursor = d.cursor;
+  for (const b of d.batches)
+    for (const line of (b.lines||[]))
+      logLines.push(`[${(b.node_id||'').slice(0,8)}/${b.worker||''}] ${line}`);
+  if (logLines.length > 2000) logLines = logLines.slice(-2000);
+  const esc = s => s.replace(/&/g,'&amp;').replace(/</g,'&lt;');
+  return '<pre style="background:#111;color:#ddd;padding:10px;'+
+    'max-height:70vh;overflow:auto;font-size:12px">' +
+    (logLines.length ? logLines.map(esc).join('\\n')
+                     : '(no worker log lines yet)') + '</pre>';
+}
+async function renderEvents(){
+  const evs = await getJSON('/api/events');
+  return table(evs.map(e => ({
+    ts: e.ts, kind: e.kind, name: e.name, task: e.task_id,
+    node: e.node,
+    duration: e.duration != null ? e.duration.toFixed(4)+'s' : '-',
+    detail: JSON.stringify(e.detail),
   })));
 }
 async function refresh(){

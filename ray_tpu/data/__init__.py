@@ -18,6 +18,14 @@ from ray_tpu.data.dataset import (
     read_numpy,
     read_parquet,
 )
+from ray_tpu.data.connectors import (
+    read_mongo,
+    read_parquet_partitioned,
+    read_sql,
+    read_webdataset,
+    write_parquet_partitioned,
+    write_webdataset,
+)
 from ray_tpu.data.datasources import (
     read_binary_files,
     read_images,
@@ -47,4 +55,10 @@ __all__ = [
     "read_images",
     "read_tfrecords",
     "write_tfrecords",
+    "read_webdataset",
+    "write_webdataset",
+    "read_sql",
+    "read_parquet_partitioned",
+    "write_parquet_partitioned",
+    "read_mongo",
 ]

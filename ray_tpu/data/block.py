@@ -37,7 +37,24 @@ class BlockAccessor:
             for it in items:
                 for k, v in it.items():
                     cols.setdefault(k, []).append(v)
-            return pa.table(cols)
+            arrays: Dict[str, Any] = {}
+            for k, vals in cols.items():
+                # MULTI-dim ndarray cells with a uniform shape become a
+                # tensor column (reference: ArrowTensorArray) — plain
+                # pa.table rejects them. 1-D cells stay list<T> as before:
+                # a per-block uniform/ragged switch would give blocks of
+                # the same column incompatible schemas and break concat.
+                if (vals and isinstance(vals[0], np.ndarray)
+                        and vals[0].ndim >= 2
+                        and all(isinstance(v, np.ndarray)
+                                and v.shape == vals[0].shape
+                                and v.dtype == vals[0].dtype
+                                for v in vals)):
+                    arrays[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(
+                        np.ascontiguousarray(np.stack(vals)))
+                else:
+                    arrays[k] = vals
+            return pa.table(arrays)
         return pa.table({"item": list(items)})
 
     @staticmethod

@@ -436,3 +436,156 @@ class TestMemoryBudget:
         for r in refs:
             ray_tpu.get(r)
         assert stats2["max_pending"] >= stats_small["max_pending"]
+
+
+class TestConnectors:
+    """WebDataset / SQL / partitioned-parquet / Mongo (VERDICT r4 #8)."""
+
+    def test_webdataset_roundtrip(self, ray_start_regular, tmp_path):
+        from ray_tpu import data as rt_data
+        from ray_tpu.data.connectors import read_webdataset, write_webdataset
+
+        rows = [{"__key__": f"{i:04d}",
+                 "txt": f"caption {i}",
+                 "cls": i % 3,
+                 "json": {"idx": i}}
+                for i in range(25)]
+        ds = rt_data.from_items(rows)
+        write_webdataset(ds, str(tmp_path / "wds"), rows_per_shard=10)
+        import os
+
+        shards = sorted(os.listdir(tmp_path / "wds"))
+        assert len(shards) == 3, shards  # 10 + 10 + 5
+
+        back = read_webdataset(str(tmp_path / "wds")).take_all()
+        assert len(back) == 25
+        back.sort(key=lambda r: r["__key__"])
+        assert back[7]["txt"] == "caption 7"
+        assert back[7]["cls"] == 7 % 3
+        assert back[7]["json"] == {"idx": 7}
+
+    def test_webdataset_suffix_filter_and_images(self, ray_start_regular, tmp_path):
+        import io
+        import tarfile
+
+        import numpy as np
+        from PIL import Image
+
+        from ray_tpu.data.connectors import read_webdataset
+
+        p = tmp_path / "shard-0.tar"
+        with tarfile.open(p, "w") as tar:
+            for i in range(3):
+                img = Image.fromarray(
+                    np.full((4, 4, 3), i * 10, np.uint8))
+                buf = io.BytesIO()
+                img.save(buf, format="PNG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"{i:03d}.png")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+                lbl = str(i).encode()
+                info = tarfile.TarInfo(f"{i:03d}.cls")
+                info.size = len(lbl)
+                tar.addfile(info, io.BytesIO(lbl))
+
+        rows = read_webdataset(str(p), decode_images=True).take_all()
+        assert len(rows) == 3
+        rows.sort(key=lambda r: r["__key__"])
+        assert rows[1]["png"].shape == (4, 4, 3)
+        assert int(rows[1]["png"][0, 0, 0]) == 10
+        assert rows[1]["cls"] == 1
+
+        only_cls = read_webdataset(str(p), suffixes=[".cls"]).take_all()
+        assert all("png" not in r for r in only_cls)
+
+    def test_sql_read_and_sharded(self, ray_start_regular, tmp_path):
+        import sqlite3
+
+        from ray_tpu.data.connectors import read_sql
+
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE metrics (id INTEGER, name TEXT, value REAL)")
+        conn.executemany("INSERT INTO metrics VALUES (?, ?, ?)",
+                         [(i, f"m{i}", i * 0.5) for i in range(40)])
+        conn.commit()
+        conn.close()
+
+        factory = lambda: __import__("sqlite3").connect(db)
+        ds = read_sql("SELECT * FROM metrics", factory)
+        rows = ds.take_all()
+        assert len(rows) == 40
+        assert {r["name"] for r in rows} == {f"m{i}" for i in range(40)}
+
+        sharded = read_sql("SELECT * FROM metrics WHERE value >= 5.0",
+                           factory, shard_key="id", parallelism=4)
+        assert sharded.num_blocks() == 4
+        srows = sharded.take_all()
+        assert len(srows) == 30  # ids 10..39
+        assert {r["id"] for r in srows} == set(range(10, 40))
+
+    def test_parquet_partition_pruning(self, ray_start_regular, tmp_path):
+        from ray_tpu import data as rt_data
+        from ray_tpu.data.connectors import (
+            read_parquet_partitioned,
+            write_parquet_partitioned,
+        )
+
+        rows = [{"day": f"2026-07-{d:02d}", "shard": s % 2, "x": d * 10 + s}
+                for d in (1, 2, 3) for s in range(4)]
+        write_parquet_partitioned(rt_data.from_items(rows),
+                                  str(tmp_path / "pq"),
+                                  partition_cols=["day"])
+        import os
+
+        assert sorted(os.listdir(tmp_path / "pq")) == [
+            "day=2026-07-01", "day=2026-07-02", "day=2026-07-03"]
+
+        # Pruned read: only day 2 files are opened; partition col attached.
+        ds = read_parquet_partitioned(
+            str(tmp_path / "pq"),
+            partition_filter=lambda p: p["day"] == "2026-07-02")
+        got = ds.take_all()
+        assert len(got) == 4
+        assert all(r["day"] == "2026-07-02" for r in got)
+        assert {r["x"] for r in got} == {20, 21, 22, 23}
+
+        full = read_parquet_partitioned(str(tmp_path / "pq")).take_all()
+        assert len(full) == 12
+
+    def test_mongo_with_injected_client(self, ray_start_regular):
+        from ray_tpu.data.connectors import read_mongo
+
+        class FakeCollection:
+            def __init__(self, docs): self._docs = docs
+            def find(self): return list(self._docs)
+            def aggregate(self, stages):
+                docs = list(self._docs)
+                for st in stages:
+                    if "$match" in st:
+                        docs = [d for d in docs
+                                if all(d.get(k) == v
+                                       for k, v in st["$match"].items())]
+                return docs
+
+        class FakeDB(dict):
+            pass
+
+        class FakeClient:
+            def __init__(self, docs):
+                self._db = FakeDB(events=FakeCollection(docs))
+            def __getitem__(self, name): return self._db
+            def close(self): pass
+
+        docs = [{"_id": i, "kind": "a" if i % 2 else "b", "v": i}
+                for i in range(10)]
+        ds = read_mongo("mongodb://unused", "db", "events",
+                        _client_factory=lambda: FakeClient(docs))
+        assert len(ds.take_all()) == 10
+
+        filtered = read_mongo(
+            "mongodb://unused", "db", "events",
+            pipeline=[{"$match": {"kind": "a"}}],
+            _client_factory=lambda: FakeClient(docs)).take_all()
+        assert len(filtered) == 5 and all(r["kind"] == "a" for r in filtered)
