@@ -18,7 +18,13 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Host-collective benchmark: always CPU (see core_perf.py — a wedged TPU
+# tunnel must not hang the control-plane benches at jax init).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
@@ -80,9 +86,29 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--mb", type=int, default=16)
     parser.add_argument("--worlds", default="2,4")
+    parser.add_argument("--round", type=int, default=0,
+                        help="write BENCH_collectives_rNN.json at repo root")
     args = parser.parse_args()
+    results = []
     for world in [int(w) for w in args.worlds.split(",")]:
-        print(json.dumps(bench_world(world, args.mb)), flush=True)
+        r = bench_world(world, args.mb)
+        oob = os.environ.get("RAY_TPU_RPC_OOB", "1") != "0"
+        shm = os.environ.get("RAY_TPU_COLLECTIVE_SHM", "1") != "0"
+        r["transport"] = (("oob" if oob else "pickled") + "-socket"
+                          + ("+shm" if shm else ""))
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.round:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            f"BENCH_collectives_r{args.round:02d}.json")
+        existing = []
+        if os.path.exists(path):
+            with open(path) as f:
+                existing = json.load(f).get("results", [])
+        with open(path, "w") as f:
+            json.dump({"results": existing + results}, f, indent=1)
+        print(f"wrote {path}")
     return 0
 
 

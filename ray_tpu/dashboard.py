@@ -250,12 +250,18 @@ function bar(frac){
   const pct = Math.round(Math.min(1, Math.max(0, frac)) * 100);
   return `<span class="bar"><div style="width:${pct}%"></div></span> ${pct}%`;
 }
+function escHtml(s){
+  return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+    .replace(/>/g,'&gt;').replace(/"/g,'&quot;');
+}
 function table(rows){
   if (!rows || !rows.length) return '<p class="muted">none</p>';
   const cols = Object.keys(rows[0]);
-  return '<table><tr>' + cols.map(c=>`<th>${c}</th>`).join('') + '</tr>' +
-    rows.map(r => '<tr>' + cols.map(c =>
-      `<td>${typeof r[c]==='object'?JSON.stringify(r[c]):r[c]}</td>`
+  // Cell content is DATA (task names, event payloads, user metadata):
+  // always escaped before it reaches innerHTML.
+  return '<table><tr>' + cols.map(c=>`<th>${escHtml(c)}</th>`).join('') +
+    '</tr>' + rows.map(r => '<tr>' + cols.map(c =>
+      `<td>${escHtml(typeof r[c]==='object'?JSON.stringify(r[c]):r[c])}</td>`
     ).join('') + '</tr>').join('') + '</table>';
 }
 function mkTable(url){

@@ -154,7 +154,28 @@ class BlockAccessor:
         blocks = [b for b in blocks if b is not None and b.num_rows >= 0]
         if not blocks:
             return pa.table({})
-        return pa.concat_tables(blocks, promote_options="default")
+        try:
+            return pa.concat_tables(blocks, promote_options="default")
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            # Schema clash — typically tensor columns whose per-block
+            # shapes differ (e.g. images of mixed sizes: each block
+            # inferred its own fixed_shape_tensor type). Demote tensor
+            # columns to list<...> so the union is representable; cells
+            # keep their values (to_pylist), shapes are no longer carried
+            # by the schema.
+            demoted = []
+            for b in blocks:
+                cols = {}
+                for name in b.column_names:
+                    col = b.column(name)
+                    if isinstance(col.type, pa.FixedShapeTensorType):
+                        cols[name] = pa.array(
+                            [v.tolist() if v is not None else None
+                             for v in col.combine_chunks().to_numpy_ndarray()])
+                    else:
+                        cols[name] = col
+                demoted.append(pa.table(cols))
+            return pa.concat_tables(demoted, promote_options="default")
 
     def sample(self, n: int, seed: Optional[int] = None) -> Block:
         rng = np.random.default_rng(seed)

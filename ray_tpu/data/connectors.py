@@ -48,13 +48,32 @@ _WDS_AUTO_DECODE = {
 
 
 def _decode_member(suffix: str, payload: bytes, decode_images: bool):
+    if suffix == ".npy":
+        return np.load(io.BytesIO(payload), allow_pickle=False)
     if suffix in _WDS_AUTO_DECODE:
         return _WDS_AUTO_DECODE[suffix](payload)
     if decode_images and suffix in (".jpg", ".jpeg", ".png", ".bmp"):
         from PIL import Image
 
         return np.asarray(Image.open(io.BytesIO(payload)))
-    return payload  # raw bytes (npy/bin/unknown — caller maps further)
+    return payload  # raw bytes (bin/unknown — caller maps further)
+
+
+_WDS_TYPED_SUFFIXES = (".txt", ".cls", ".json", ".npy")
+
+
+def _split_member(base: str):
+    """WebDataset naming: the sample key is the name up to the FIRST dot;
+    everything after is the (possibly dotted) extension. A trailing typed
+    suffix (``caption.txt``, ``meta.json``, ``emb.npy``) carries the
+    value's TYPE while the rest names the column — how the writer
+    round-trips str/int/dict/ndarray columns with arbitrary names."""
+    stem, _, ext = base.partition(".")
+    parts = ext.split(".")
+    type_suffix = "." + parts[-1].lower() if parts else ""
+    if len(parts) > 1 and type_suffix in _WDS_TYPED_SUFFIXES:
+        return stem, ".".join(parts[:-1]), type_suffix
+    return stem, ext, type_suffix
 
 
 def read_webdataset(paths: Union[str, List[str]], *,
@@ -77,15 +96,17 @@ def read_webdataset(paths: Union[str, List[str]], *,
                     if not member.isfile():
                         continue
                     base = os.path.basename(member.name)
-                    stem, suffix = os.path.splitext(base)
-                    if suffixes is not None and suffix not in suffixes:
+                    stem, column, type_suffix = _split_member(base)
+                    if (suffixes is not None
+                            and "." + column not in suffixes
+                            and type_suffix not in suffixes):
                         continue
                     payload = tar.extractfile(member).read()
                     if stem not in samples:
                         samples[stem] = {"__key__": stem}
                         order.append(stem)
-                    samples[stem][suffix.lstrip(".")] = _decode_member(
-                        suffix.lower(), payload, decode_images)
+                    samples[stem][column] = _decode_member(
+                        type_suffix, payload, decode_images)
             return BlockAccessor.from_items([samples[k] for k in order])
 
         return read
@@ -119,19 +140,28 @@ def write_webdataset(ds: Dataset, path: str, *,
             for col, val in row.items():
                 if col == "__key__":
                     continue
+                # Typed double extensions (``caption.txt``, ``meta.json``)
+                # make ANY column name round-trip with its Python type;
+                # a column already named after its type stays single-ext.
+                def name(type_ext: str) -> str:
+                    if "." + col == type_ext:
+                        return f"{key}{type_ext}"
+                    return f"{key}.{col}{type_ext}"
+
                 if isinstance(val, (bytes, bytearray)):
                     add(tar, f"{key}.{col}", bytes(val))
                 elif isinstance(val, str):
-                    add(tar, f"{key}.txt" if col == "txt" else f"{key}.{col}",
-                        val.encode("utf-8"))
+                    add(tar, name(".txt"), val.encode("utf-8"))
+                elif isinstance(val, (bool, np.bool_)):
+                    add(tar, name(".json"), json.dumps(bool(val)).encode())
                 elif isinstance(val, (int, np.integer)):
-                    add(tar, f"{key}.{col}", str(int(val)).encode())
+                    add(tar, name(".cls"), str(int(val)).encode())
                 elif isinstance(val, np.ndarray):
                     buf = io.BytesIO()
                     np.save(buf, val)
-                    add(tar, f"{key}.{col}", buf.getvalue())
+                    add(tar, name(".npy"), buf.getvalue())
                 else:
-                    add(tar, f"{key}.{col}",
+                    add(tar, name(".json"),
                         json.dumps(val).encode("utf-8"))
             idx += 1
             n_in_shard += 1
@@ -185,8 +215,17 @@ def read_sql(sql: str, connection_factory: Callable[[], Any], *,
     if parallelism <= 1:
         tasks = [make_task(None)]
     else:
-        tasks = [make_task(f"({shard_key} % {parallelism}) = {i}")
-                 for i in range(parallelism)]
+        # Sign-normalized modulo (SQL % keeps the dividend's sign, so a
+        # negative key would match no shard) + NULL keys routed to shard 0
+        # (NULL % N is NULL — silently dropped otherwise).
+        def clause(i: int) -> str:
+            c = (f"((({shard_key}) % {parallelism}) + {parallelism}) "
+                 f"% {parallelism} = {i}")
+            if i == 0:
+                c = f"({c} OR ({shard_key}) IS NULL)"
+            return c
+
+        tasks = [make_task(clause(i)) for i in range(parallelism)]
     return Dataset(LogicalPlan(Read(tasks)))
 
 
@@ -247,22 +286,38 @@ def read_parquet_partitioned(
 
 def write_parquet_partitioned(ds: Dataset, root: str, *,
                               partition_cols: List[str]) -> None:
-    """Writer side of the hive layout: rows are grouped by the partition
-    columns; each group lands under ``root/key=value/...``."""
+    """Writer side of the hive layout: rows land under
+    ``root/key=value/...``. STREAMING: blocks are processed one at a time
+    and each partition keeps one open ``ParquetWriter`` (appending row
+    groups), so datasets larger than driver RAM write fine — the whole
+    corpus is never materialized."""
     import pyarrow.parquet as pq
 
-    groups: Dict[tuple, List[dict]] = {}
-    for block in ds.iter_blocks():
-        for row in BlockAccessor(block).iter_rows():
-            key = tuple(str(row[c]) for c in partition_cols)
-            groups.setdefault(key, []).append(
-                {k: v for k, v in row.items() if k not in partition_cols})
-    for key, rows in groups.items():
-        d = os.path.join(root, *(f"{c}={v}"
-                                 for c, v in zip(partition_cols, key)))
-        os.makedirs(d, exist_ok=True)
-        table = BlockAccessor.from_items(rows)
-        pq.write_table(table, os.path.join(d, "part-00000.parquet"))
+    writers: Dict[tuple, pq.ParquetWriter] = {}
+    try:
+        for block in ds.iter_blocks():
+            # Per-block grouping only (bounded memory): rows of this block
+            # split by partition value, then append to the open writers.
+            groups: Dict[tuple, List[dict]] = {}
+            for row in BlockAccessor(block).iter_rows():
+                key = tuple(str(row[c]) for c in partition_cols)
+                groups.setdefault(key, []).append(
+                    {k: v for k, v in row.items()
+                     if k not in partition_cols})
+            for key, rows in groups.items():
+                table = BlockAccessor.from_items(rows)
+                w = writers.get(key)
+                if w is None:
+                    d = os.path.join(root, *(f"{c}={v}" for c, v in
+                                             zip(partition_cols, key)))
+                    os.makedirs(d, exist_ok=True)
+                    w = pq.ParquetWriter(
+                        os.path.join(d, "part-00000.parquet"), table.schema)
+                    writers[key] = w
+                w.write_table(table)
+    finally:
+        for w in writers.values():
+            w.close()
 
 
 # ---------------------------------------------------------------------------

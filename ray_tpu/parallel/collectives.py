@@ -148,21 +148,85 @@ def _compute_for(descriptor: tuple, world: int):
     raise ValueError(f"unknown collective descriptor {descriptor}")
 
 
+class _ShmIncoming:
+    """A chunk delivered by shm reference: the array is a zero-copy view
+    into the node's object store; ``close()`` releases the view and acks
+    the origin so it can delete the backing object."""
+
+    __slots__ = ("arr", "key", "origin", "_shm", "_closed")
+
+    def __init__(self, arr, key, origin, shm):
+        self.arr = arr
+        self.key = key
+        self.origin = origin
+        self._shm = shm
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.release(self.key)
+        except Exception:  # noqa: BLE001 — store gone at shutdown
+            pass
+
+
 class _MemberService:
     """Every rank's RPC surface in the cross-process backend: a tagged
     mailbox. Peers deliver (tag -> payload) messages; the local rank waits
     on its mailbox. Tags are (op_seq, step, src) so concurrent steps of
-    pipelined rounds can't mix."""
+    pipelined rounds can't mix.
+
+    Same-node peers can deliver big tensors BY SHM REFERENCE
+    (``deliver_shm``): the payload crosses as a 16-byte object key; the
+    receiver maps a zero-copy view out of the shared arena — the §5.8
+    "large host tensors ride the shm object plane" tier."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.box: Dict[tuple, object] = {}
+        self.shm = None  # set by the group when a node store is reachable
+        # Origin-side: shm chunks awaiting consumer acks -> pending count.
+        self._outstanding: Dict[bytes, int] = {}
 
     def deliver(self, tag: tuple, value) -> None:
         with self.cv:
             self.box[tuple(tag)] = value
             self.cv.notify_all()
+
+    def deliver_shm(self, tag: tuple, key: bytes, shape, dtype: str,
+                    origin: int) -> None:
+        import numpy as _np
+
+        view = self.shm.get(key) if self.shm is not None else None
+        if view is None:
+            raise RuntimeError(
+                f"shm chunk {key.hex()[:12]} not found in local store")
+        arr = _np.frombuffer(view, dtype=_np.dtype(dtype)).reshape(shape)
+        with self.cv:
+            self.box[tuple(tag)] = _ShmIncoming(arr, key, origin, self.shm)
+            self.cv.notify_all()
+
+    def note_outstanding(self, key: bytes, consumers: int) -> None:
+        with self.lock:
+            self._outstanding[key] = consumers
+
+    def shm_done(self, key: bytes) -> None:
+        """Consumer ack: delete the backing object once all consumers of
+        this chunk have released their views."""
+        with self.lock:
+            n = self._outstanding.get(key, 1) - 1
+            if n > 0:
+                self._outstanding[key] = n
+                return
+            self._outstanding.pop(key, None)
+        if self.shm is not None:
+            try:
+                self.shm.delete(key)
+            except Exception:  # noqa: BLE001 — store gone at shutdown
+                pass
 
     def take(self, tag: tuple, timeout: Optional[float] = 120.0):
         import time as _time
@@ -193,8 +257,13 @@ class _DistributedGroup:
     tensors inside jitted programs use XLA collectives over ICI instead.
     """
 
+    # Payloads at or above this ride the shm object plane between
+    # same-node ranks (below it, the socket path's latency wins).
+    SHM_MIN_BYTES = 1 << 20
+
     def __init__(self, world_size: int, rank: int, addrs: List[str],
-                 service: _MemberService, server):
+                 service: _MemberService, server,
+                 stores: Optional[List[Optional[str]]] = None):
         from ray_tpu.core.rpc import RpcClientPool
 
         self.world_size = world_size
@@ -205,6 +274,20 @@ class _DistributedGroup:
         self._peers = RpcClientPool()
         self._op_seq = 0
         self._op_lock = threading.Lock()
+        # Same-node shm fast path: ranks publishing the same store name
+        # share one arena; big chunks cross as object keys.
+        self._stores = stores or [None] * world_size
+        # The store handle is opened by _init_distributed_group BEFORE the
+        # rank's address is published (a peer may deliver_shm the moment it
+        # can see us); here we just adopt it off the service.
+        self._shm = service.shm
+        if self._shm is None:
+            self._stores = [None] * world_size
+        # Homogeneous single-node group: broadcast can write once and
+        # circulate one key through the whole tree.
+        self._all_same_store = bool(
+            self._stores[0]
+            and all(s == self._stores[0] for s in self._stores))
 
     # -- plumbing -----------------------------------------------------------
 
@@ -219,6 +302,85 @@ class _DistributedGroup:
             return
         self._peers.get(self._addrs[dst]).call(
             "deliver", tag, value, timeout=120.0)
+
+    def _ring_shm_consumers(self, first_dst: int, hops: int) -> int:
+        """How many CONSECUTIVE downstream ring receivers (starting at
+        ``first_dst``, following +1 for ``hops`` hops) share this rank's
+        store. Only those receive the chunk BY KEY and ack; once the ring
+        crosses to a different store the chunk continues as socket copies
+        — counting those would leave the backing object undeletable."""
+        n = self.world_size
+        count = 0
+        r = first_dst
+        for _ in range(hops):
+            if self._stores[r % n] != self._stores[self.rank]:
+                break
+            count += 1
+            r += 1
+        return count
+
+    def _send_async(self, dst: int, tag: tuple, value, *,
+                    consumers: int = 1, holder=None):
+        """Fire-and-overlap send: returns a future (or None for self-
+        delivery). Ring steps overlap their outgoing transfer with the
+        blocking wait for the incoming one — full-duplex links move both
+        directions at once instead of serializing on the deliver ack.
+
+        Big numpy payloads to SAME-NODE peers go by shm reference: one
+        copy into the shared arena, a 16-byte key over the socket, a
+        zero-copy view on the other side. A chunk already BACKED by shm
+        (``holder``) is forwarded by key — zero copies on any hop;
+        ``consumers`` (total ranks that will ack) is fixed by the
+        creator."""
+        if dst == self.rank:
+            self._service.deliver(tag, value)
+            return None
+        same_store = (self._shm is not None
+                      and self._stores[dst] == self._stores[self.rank])
+        if holder is not None and same_store:
+            return self._peers.get(self._addrs[dst]).call_async(
+                "deliver_shm", tag, holder.key, value.shape,
+                value.dtype.str, holder.origin)
+        if (same_store
+                and isinstance(value, np.ndarray)
+                and value.nbytes >= self.SHM_MIN_BYTES
+                and consumers > 0):
+            import os as _os
+
+            key = _os.urandom(16)
+            view = self._shm.create(key, value.nbytes)
+            if view is not None:
+                flat = np.frombuffer(view, dtype=value.dtype)
+                flat[:] = np.ascontiguousarray(value).reshape(-1)
+                self._shm.seal(key)
+                self._service.note_outstanding(key, consumers)
+                return self._peers.get(self._addrs[dst]).call_async(
+                    "deliver_shm", tag, key, value.shape, value.dtype.str,
+                    self.rank)
+            # Arena full: fall through to the socket path.
+        return self._peers.get(self._addrs[dst]).call_async(
+            "deliver", tag, value)
+
+    def _materialize(self, incoming):
+        """(ndarray, holder) for a received chunk. shm-delivered chunks
+        come back as zero-copy views with a non-None holder: the caller
+        uses the array, then MUST call ``_finish_consume(holder)`` (a
+        caller that keeps the array beyond the step copies it first)."""
+        if isinstance(incoming, _ShmIncoming):
+            return incoming.arr, incoming
+        return np.asarray(incoming), None
+
+    def _ack_shm(self, incoming: "_ShmIncoming") -> None:
+        try:
+            self._peers.get(self._addrs[incoming.origin]).notify(
+                "shm_done", incoming.key)
+        except Exception:  # noqa: BLE001 — origin gone; its store reaps
+            pass
+
+    def _finish_consume(self, holder) -> None:
+        if holder is not None:
+            holder.close()
+            self._ack_shm(holder)
 
     def _recv(self, tag: tuple, timeout: float = 120.0):
         return self._service.take(tag, timeout)
@@ -270,20 +432,40 @@ class _DistributedGroup:
         for step in range(n - 1):
             send_idx = (self.rank - step) % n
             recv_idx = (self.rank - step - 1) % n
-            self._send(nxt, (seq, "rs", step), chunks[send_idx])
-            incoming = self._recv((seq, "rs", step))
-            chunks[recv_idx] = _REDUCE_OPS[acc_op](
-                [chunks[recv_idx], np.asarray(incoming)])
+            fut = self._send_async(nxt, (seq, "rs", step), chunks[send_idx])
+            arr, holder = self._materialize(self._recv((seq, "rs", step)))
+            chunks[recv_idx] = _REDUCE_OPS[acc_op]([chunks[recv_idx], arr])
+            self._finish_consume(holder)
+            if fut is not None:
+                fut.result(timeout=120.0)
         owned = (self.rank + 1) % n  # fully reduced chunk this rank holds
         if mean:
             chunks[owned] = chunks[owned] / n
-        # Phase 2 — allgather the reduced chunks around the ring.
+        # Phase 2 — allgather the reduced chunks around the ring. Each
+        # reduced chunk is written to shm ONCE by its owner and then
+        # FORWARDED BY KEY: every rank reads the same backing object
+        # (zero-copy views, consumed by the final concatenate) and acks;
+        # the owner deletes after all n-1 consumers ack.
+        holders: List[Optional[_ShmIncoming]] = [None] * n
         for step in range(n - 1):
             send_idx = (self.rank + 1 - step) % n
             recv_idx = (self.rank - step) % n
-            self._send(nxt, (seq, "ag", step), chunks[send_idx])
-            chunks[recv_idx] = np.asarray(self._recv((seq, "ag", step)))
+            # consumers = the consecutive same-store receivers downstream
+            # of THIS send (the chunk has n-1-step hops left; once the
+            # ring crosses stores it continues as socket copies that never
+            # ack — counting them would leak the backing object).
+            fut = self._send_async(
+                nxt, (seq, "ag", step), chunks[send_idx],
+                consumers=self._ring_shm_consumers(nxt, n - 1 - step),
+                holder=holders[send_idx])
+            arr, holder = self._materialize(self._recv((seq, "ag", step)))
+            chunks[recv_idx] = arr  # shm chunks stay zero-copy views
+            holders[recv_idx] = holder
+            if fut is not None:
+                fut.result(timeout=120.0)
         result = np.concatenate([np.atleast_1d(c) for c in chunks], axis=0)
+        for h in holders:
+            self._finish_consume(h)
         return result.reshape(orig_shape)
 
     def _reduce_scatter(self, seq: int, value, op: str):
@@ -298,10 +480,12 @@ class _DistributedGroup:
         for step in range(n - 1):
             send_idx = (self.rank - step) % n
             recv_idx = (self.rank - step - 1) % n
-            self._send(nxt, (seq, "rs", step), chunks[send_idx])
-            incoming = self._recv((seq, "rs", step))
-            chunks[recv_idx] = _REDUCE_OPS[acc_op](
-                [chunks[recv_idx], np.asarray(incoming)])
+            fut = self._send_async(nxt, (seq, "rs", step), chunks[send_idx])
+            arr, holder = self._materialize(self._recv((seq, "rs", step)))
+            chunks[recv_idx] = _REDUCE_OPS[acc_op]([chunks[recv_idx], arr])
+            self._finish_consume(holder)
+            if fut is not None:
+                fut.result(timeout=120.0)
         owned = (self.rank + 1) % n
         res = chunks[owned]
         if mean:
@@ -309,7 +493,11 @@ class _DistributedGroup:
         # Rotate so the API's slots[rank] convention holds: ring ownership
         # is chunk (rank+1)%n; the contract gives rank its OWN index.
         self._send((self.rank + 1) % n, (seq, "rsrot", 0), res)
-        return np.asarray(self._recv((seq, "rsrot", 0)))
+        arr, holder = self._materialize(self._recv((seq, "rsrot", 0)))
+        if holder is not None:
+            arr = np.array(arr)  # returned to the caller: detach from shm
+            self._finish_consume(holder)
+        return arr
 
     def _allgather(self, seq: int, value) -> List[np.ndarray]:
         n = self.world_size
@@ -320,9 +508,15 @@ class _DistributedGroup:
         nxt = (self.rank + 1) % n
         carry_idx = self.rank
         for step in range(n - 1):
-            self._send(nxt, (seq, "ag", step), out[carry_idx])
+            fut = self._send_async(nxt, (seq, "ag", step), out[carry_idx])
             carry_idx = (self.rank - step - 1) % n
-            out[carry_idx] = np.asarray(self._recv((seq, "ag", step)))
+            arr, holder = self._materialize(self._recv((seq, "ag", step)))
+            if holder is not None:
+                arr = np.array(arr)
+                self._finish_consume(holder)
+            out[carry_idx] = arr
+            if fut is not None:
+                fut.result(timeout=120.0)
         return out  # type: ignore[return-value]
 
     def _broadcast(self, seq: int, value, src: int):
@@ -330,33 +524,81 @@ class _DistributedGroup:
         ceil(log2 N) copies (vs the hub serializing N sends)."""
         n = self.world_size
         rel = (self.rank - src) % n
+        holder = None
         if rel != 0:
-            arr = np.asarray(self._recv((seq, "bc", rel)))
+            arr, holder = self._materialize(self._recv((seq, "bc", rel)))
         else:
             arr = np.asarray(value)
         # Forward to children in the binomial tree over RELATIVE ranks:
-        # node `rel` owns children rel + 2^k for 2^k > rel.
+        # node `rel` owns children rel + 2^k for 2^k > rel. Sends overlap
+        # (async); on a homogeneous same-store group the payload is
+        # written to shm ONCE (by the root) and the whole tree circulates
+        # its key — every forward hop is a 16-byte message.
+        children = []
         k = 1
         while k < n:
             if rel < k and rel + k < n:
-                child_rel = rel + k
-                self._send((src + child_rel) % n, (seq, "bc", child_rel), arr)
+                children.append(rel + k)
             k *= 2
+        futs = []
+        key_holder = holder
+        if (children and key_holder is None and self._all_same_store
+                and self._shm is not None and isinstance(arr, np.ndarray)
+                and arr.nbytes >= self.SHM_MIN_BYTES):
+            import os as _os
+
+            key = _os.urandom(16)
+            view = self._shm.create(key, arr.nbytes)
+            if view is not None:
+                np.frombuffer(view, dtype=arr.dtype)[:] = (
+                    np.ascontiguousarray(arr).reshape(-1))
+                self._shm.seal(key)
+                self._service.note_outstanding(key, n - 1)
+                # Root-side pseudo-holder: carries the key for forwarding;
+                # the root itself never acks/closes it.
+                key_holder = _ShmIncoming(arr, key, self.rank, self._shm)
+        for child_rel in children:
+            if key_holder is not None and self._all_same_store:
+                futs.append(self._peers.get(
+                    self._addrs[(src + child_rel) % n]).call_async(
+                    "deliver_shm", (seq, "bc", child_rel), key_holder.key,
+                    arr.shape, arr.dtype.str, key_holder.origin, 0))
+            else:
+                futs.append(self._send_async(
+                    (src + child_rel) % n, (seq, "bc", child_rel), arr))
+        for fut in futs:
+            if fut is not None:
+                fut.result(timeout=120.0)
+        if holder is not None:
+            arr = np.array(arr)  # result is returned to the caller
+            self._finish_consume(holder)
         return arr
 
     def _alltoall(self, seq: int, value):
         n = self.world_size
         shards = np.array_split(np.asarray(value), n, axis=0)
+        futs = []
         for dst in range(n):
             if dst != self.rank:
-                self._send(dst, (seq, "a2a", self.rank), shards[dst])
+                futs.append(self._send_async(
+                    dst, (seq, "a2a", self.rank), shards[dst]))
         pieces = []
+        holders = []
         for s in range(n):
             if s == self.rank:
                 pieces.append(shards[self.rank])
             else:
-                pieces.append(np.asarray(self._recv((seq, "a2a", s))))
-        return np.concatenate(pieces, axis=0)
+                arr, holder = self._materialize(self._recv((seq, "a2a", s)))
+                pieces.append(arr)
+                if holder is not None:
+                    holders.append(holder)
+        result = np.concatenate(pieces, axis=0)  # copies: views die after
+        for h in holders:
+            self._finish_consume(h)
+        for fut in futs:
+            if fut is not None:
+                fut.result(timeout=120.0)
+        return result
 
     # -- p2p ----------------------------------------------------------------
 
@@ -490,14 +732,34 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
                 f"group {group_name} exists with world_size="
                 f"{existing.world_size}")
 
+    import os as _os
+
     gcs = get_runtime().gcs
     service = _MemberService()
+    # Open the node store (and arm the service's shm surface) BEFORE the
+    # address is published: a fast peer may deliver_shm the instant it can
+    # see this rank. RAY_TPU_COLLECTIVE_SHM=0 disables the shm transport
+    # (A/B benching + emergency fallback to pure sockets).
+    my_store = _os.environ.get("RAY_TPU_STORE_NAME", "")
+    if _os.environ.get("RAY_TPU_COLLECTIVE_SHM", "1") == "0":
+        my_store = ""
+    if my_store:
+        try:
+            from ray_tpu.core.native_store import NativeObjectStore
+
+            service.shm = NativeObjectStore.open(my_store)
+        except Exception:  # noqa: BLE001 — no local store: socket path
+            service.shm = None
+            my_store = ""
     server = RpcServer(service, name=f"collective-{group_name}-r{rank}",
                        max_workers=max(8, world_size + 2))
     gcs.kv_put(f"collective:{group_name}:addr:{rank}",
-               server.address.encode(), namespace="collective")
+               f"{server.address}|{my_store}".encode(),
+               namespace="collective")
     addrs: List[Optional[str]] = [None] * world_size
+    stores: List[Optional[str]] = [None] * world_size
     addrs[rank] = server.address
+    stores[rank] = my_store or None
     deadline = _time.time() + 60.0
     while any(a is None for a in addrs):
         for r in range(world_size):
@@ -505,7 +767,10 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
                 raw = gcs.kv_get(f"collective:{group_name}:addr:{r}",
                                  namespace="collective")
                 if raw:
-                    addrs[r] = raw.decode()
+                    text = raw.decode()
+                    addr, _, store = text.partition("|")
+                    addrs[r] = addr
+                    stores[r] = store or None
         if any(a is None for a in addrs):
             if _time.time() > deadline:
                 server.stop()
@@ -514,7 +779,8 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
                     f"collective group {group_name}: ranks {missing} never "
                     f"published their member address")
             _time.sleep(0.05)
-    group = _DistributedGroup(world_size, rank, addrs, service, server)
+    group = _DistributedGroup(world_size, rank, addrs, service, server,
+                              stores=stores)
     group._kv_key = f"collective:{group_name}:addr:{rank}"
     with _groups_lock:
         _groups[group_name] = group  # type: ignore[assignment]

@@ -589,3 +589,56 @@ class TestConnectors:
             pipeline=[{"$match": {"kind": "a"}}],
             _client_factory=lambda: FakeClient(docs)).take_all()
         assert len(filtered) == 5 and all(r["kind"] == "a" for r in filtered)
+
+    def test_webdataset_arbitrary_columns_roundtrip(self, ray_start_regular, tmp_path):
+        import numpy as np
+
+        from ray_tpu import data as rt_data
+        from ray_tpu.data.connectors import read_webdataset, write_webdataset
+
+        rows = [{"__key__": f"{i:03d}",
+                 "caption": f"a photo #{i}",
+                 "label": i,
+                 "meta": {"w": i * 2},
+                 "emb": np.arange(4, dtype=np.float32) + i}
+                for i in range(6)]
+        write_webdataset(rt_data.from_items(rows), str(tmp_path / "w2"))
+        back = read_webdataset(str(tmp_path / "w2")).take_all()
+        back.sort(key=lambda r: r["__key__"])
+        assert back[3]["caption"] == "a photo #3"      # str round-trips
+        assert back[3]["label"] == 3                   # int round-trips
+        assert back[3]["meta"] == {"w": 6}             # dict round-trips
+        np.testing.assert_allclose(np.asarray(back[3]["emb"]),
+                                   [3.0, 4.0, 5.0, 6.0])
+
+    def test_mixed_shape_tensor_blocks_concat(self, ray_start_regular):
+        import numpy as np
+        import pyarrow as pa
+
+        from ray_tpu.data.block import BlockAccessor
+
+        a = BlockAccessor.from_items(
+            [{"img": np.zeros((2, 2), np.uint8)} for _ in range(3)])
+        b = BlockAccessor.from_items(
+            [{"img": np.zeros((4, 4), np.uint8)} for _ in range(2)])
+        out = BlockAccessor.concat([a, b])
+        assert out.num_rows == 5  # schema clash demoted, not raised
+
+    def test_sql_shard_null_and_negative_keys(self, ray_start_regular, tmp_path):
+        import sqlite3
+
+        from ray_tpu.data.connectors import read_sql
+
+        db = str(tmp_path / "neg.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (id INTEGER, v TEXT)")
+        conn.executemany("INSERT INTO t VALUES (?, ?)",
+                         [(-3, "neg"), (None, "null"), (5, "pos"),
+                          (0, "zero")])
+        conn.commit()
+        conn.close()
+        factory = lambda: __import__("sqlite3").connect(db)
+        rows = read_sql("SELECT * FROM t", factory,
+                        shard_key="id", parallelism=3).take_all()
+        assert len(rows) == 4, rows  # no silent drops
+        assert {r["v"] for r in rows} == {"neg", "null", "pos", "zero"}
