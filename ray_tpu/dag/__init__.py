@@ -9,6 +9,7 @@ channel transport is a later extension).
 from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
 from ray_tpu.dag.compiled_dag import CompiledDAG, DAGRef
 from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
+from ray_tpu.dag.device_channel import DeviceChannel
 
 __all__ = [
     "InputNode",
@@ -19,4 +20,5 @@ __all__ = [
     "Channel",
     "ChannelClosed",
     "ChannelTimeout",
+    "DeviceChannel",
 ]

@@ -86,11 +86,11 @@ class Channel:
         # through _write_raw instead).
         self._write_payload(serialization.dumps(value), timeout)
 
-    def _write_payload(self, payload: bytes, timeout: Optional[float]) -> None:
-        if len(payload) > self.capacity:
-            raise ValueError(
-                f"payload of {len(payload)} bytes exceeds channel capacity "
-                f"{self.capacity}")
+    def _wait_writable(self, timeout: Optional[float]) -> None:
+        """Block until the previous value is acked, then mark a write in
+        progress (odd seq). Split out so callers (DeviceChannel) can land
+        payload bytes DIRECTLY in the shm region between this and
+        ``_publish`` — no intermediate buffer."""
         deadline = None if timeout is None else time.time() + timeout
         spins = 0
         while True:
@@ -106,31 +106,70 @@ class Channel:
                 # wakeups/s per stage while idle.
                 time.sleep(min(_SPIN_S * (1 << min(spins // 64, 6)), _SPIN_MAX_S))
         self._store_write_seq(write_seq + 1)          # mark in-progress (odd)
-        self._mm[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
-        struct.pack_into("<Q", self._mm, 16, len(payload))
-        self._store_write_seq(write_seq + 2)          # publish (even)
+        self._pending_write_seq = write_seq
 
-    def read(self, timeout: Optional[float] = 30.0) -> Any:
-        """Block until a value newer than the last read appears; ack it."""
+    def _publish(self, length: int) -> None:
+        struct.pack_into("<Q", self._mm, 16, length)
+        self._store_write_seq(self._pending_write_seq + 2)  # publish (even)
+
+    def _write_payload(self, payload: bytes, timeout: Optional[float]) -> None:
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        self._wait_writable(timeout)
+        self._mm[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
+        self._publish(len(payload))
+
+    def _read_view(self, timeout: Optional[float]):
+        """Block for the next value; return ``(view, length)`` WITHOUT
+        acking — the bytes stay stable (the writer can't start a new write
+        before our ack) until the caller's ``_ack_current``. The zero-copy
+        read half of the DeviceChannel protocol."""
         deadline = None if timeout is None else time.time() + timeout
         spins = 0
         while True:
             write_seq, _ack, length = self._load()
             if write_seq % 2 == 0 and write_seq > self._read_seq:
-                payload = bytes(self._mm[HEADER_SIZE:HEADER_SIZE + length])
-                # seqlock validation: the writer can't start a new write
-                # before our ack, so a single stability check suffices.
-                if self._load()[0] == write_seq:
-                    self._read_seq = write_seq
-                    self._store_ack(write_seq)
-                    if payload == _CLOSE:
-                        raise ChannelClosed(self.name)
-                    return serialization.loads(payload)
+                self._pending_read_seq = write_seq
+                return memoryview(self._mm)[
+                    HEADER_SIZE:HEADER_SIZE + length], length
             if deadline is not None and time.time() > deadline:
                 raise ChannelTimeout(f"no value arrived in {self.name}")
             spins += 1
             if spins > _TIGHT_SPINS:
                 time.sleep(min(_SPIN_S * (1 << min(spins // 64, 6)), _SPIN_MAX_S))
+
+    def _ack_current(self) -> None:
+        self._read_seq = self._pending_read_seq
+        self._store_ack(self._pending_read_seq)
+
+    def read(self, timeout: Optional[float] = 30.0) -> Any:
+        """Block until a value newer than the last read appears; ack it."""
+        while True:
+            view, length = self._read_view(timeout)
+            payload = bytes(view[:length])
+            # Stability recheck: a close() FORCE-publish may overwrite the
+            # payload mid-copy (the one writer path that skips the ack
+            # handshake); a changed seq means the copy is torn — retry and
+            # pick up the pill.
+            if self._load()[0] == self._pending_read_seq:
+                break
+        self._ack_current()
+        if payload == _CLOSE:
+            raise ChannelClosed(self.name)
+        return serialization.loads(payload)
+
+    def _force_publish(self, payload: bytes) -> None:
+        """Teardown-only: publish ``payload`` WITHOUT waiting for the
+        reader's ack (used when the reader never drained the last value).
+        Readers detect the overwrite via the stability recheck."""
+        write_seq, _, _ = self._load()
+        base = write_seq if write_seq % 2 == 0 else write_seq + 1
+        self._store_write_seq(base + 1)
+        self._mm[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
+        struct.pack_into("<Q", self._mm, 16, len(payload))
+        self._store_write_seq(base + 2)
 
     def close(self) -> None:
         """Wake the reader with a poison pill (teardown path)."""
@@ -138,18 +177,17 @@ class Channel:
             self._write_payload(_CLOSE, timeout=0.5)
         except (ChannelTimeout, ValueError):
             # Reader never drained the last value; force-publish the pill.
-            write_seq, _, _ = self._load()
-            base = write_seq if write_seq % 2 == 0 else write_seq + 1
-            self._store_write_seq(base + 1)
-            self._mm[HEADER_SIZE:HEADER_SIZE + len(_CLOSE)] = _CLOSE
-            struct.pack_into("<Q", self._mm, 16, len(_CLOSE))
-            self._store_write_seq(base + 2)
+            self._force_publish(_CLOSE)
 
     def destroy(self) -> None:
         try:
             self._mm.close()
             self._f.close()
-        except OSError:
+        except (OSError, BufferError):
+            # BufferError: a zero-copy view handed out by _read_view is
+            # still referenced (e.g. a device array's source buffer whose
+            # consumer hasn't been collected yet) — the mmap closes when
+            # the last view dies; unlink the backing file regardless.
             pass
         try:
             os.unlink(f"/dev/shm/{self.name}")

@@ -63,9 +63,12 @@ class CompiledDAG:
     def __init__(self, leaf: DAGNode, *, channel_capacity: int = 4 * 1024 * 1024,
                  channel_type: str = "auto"):
         """``channel_type``: "shm" (same-host mutable shm), "socket"
-        (cross-host TCP), or "auto" — per EDGE, shm when both endpoints
-        share a host, sockets otherwise (the reference's aDAG channels are
-        likewise transport-selected per pair, experimental/channel.py:51).
+        (cross-host TCP), "device" (DeviceChannel — array payloads land as
+        ``jax.Array`` on each stage's device with double-buffered host DMA,
+        the SURVEY §2.1 accelerator-channel tier), or "auto" — per EDGE,
+        shm when both endpoints share a host, sockets otherwise (the
+        reference's aDAG channels are likewise transport-selected per
+        pair, experimental/channel.py:51).
         """
         chain = leaf.chain()
         if not chain or not isinstance(chain[0], InputNode):
@@ -89,6 +92,11 @@ class CompiledDAG:
         hosts = self._endpoint_hosts(stages) if channel_type == "auto" else None
         self._channels = []
         for i in range(len(stages) + 1):
+            if channel_type == "device":
+                from ray_tpu.dag.device_channel import DeviceChannel
+
+                self._channels.append(DeviceChannel(capacity=channel_capacity))
+                continue
             if channel_type == "socket":
                 cross = True
             elif channel_type == "shm":

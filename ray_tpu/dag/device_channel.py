@@ -1,0 +1,192 @@
+"""Device channels — on-device tensors between pipelined actor stages.
+
+The device tier of the compiled-DAG transport (SURVEY §2.1 TPU mapping:
+the aDAG mutable channels "map to on-device buffers with double-buffered
+host DMA"; reference analog: the accelerator channels reached through
+``python/ray/experimental/channel.py:51``, where GPU payloads ride NCCL
+instead of plasma). Separate processes own separate PJRT clients, so a
+tensor crossing an actor boundary must traverse host memory — the job of
+this channel is to make that traversal cost ONE device→host DMA, one shm
+landing, and one host→device DMA, with the two directions overlapped:
+
+- the payload is written as dtype/shape header + raw buffer straight into
+  the shm segment (no pickle on either side);
+- TWO shm slots alternate (ping-pong): the writer fills slot ``k+1`` while
+  the reader's host→device upload of slot ``k`` is still in flight, so
+  the DMA of one step hides behind the transfer of the next — the
+  double-buffering half of the design;
+- the reader gets a ``jax.Array`` committed to its device (or sharding),
+  and only acks the slot once the upload is done — the writer can never
+  overwrite bytes an in-flight DMA still reads.
+
+Non-array payloads (control messages, pytrees with small leaves) fall back
+to the pickled path of the underlying channel transparently.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import uuid
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout, HEADER_SIZE
+
+# Payload kinds inside a slot: raw array (header + buffer) or pickled.
+_KIND_ARRAY = 0
+_KIND_PICKLE = 1
+_META = struct.Struct("<BI")  # kind, header_len
+
+
+class DeviceChannel:
+    """Single-writer single-reader device-tensor channel (ping-pong)."""
+
+    def __init__(self, name: Optional[str] = None,
+                 capacity: int = 64 * 1024 * 1024, create: bool = True,
+                 device: Any = None, sharding: Any = None):
+        self.name = name or f"rtpu-devchan-{uuid.uuid4().hex[:12]}"
+        self.capacity = capacity
+        # Two independent seqlock slots; writer/reader alternate in step.
+        self._slots = [
+            Channel(f"{self.name}-p{i}", capacity=capacity, create=create)
+            for i in (0, 1)
+        ]
+        self._wcursor = 0
+        self._rcursor = 0
+        self._device = device
+        self._sharding = sharding
+        # The previous read's device array: its upload must be complete
+        # before we ack the slot it came from (deferred ack = the overlap).
+        self._pending_ack: Optional[tuple] = None
+
+    # -- write ---------------------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
+        # The slot cursor advances ONLY on success: an errored write
+        # (oversized payload, timeout) must leave the ping-pong in step
+        # with the reader or every later value lands one slot off.
+        slot = self._slots[self._wcursor % 2]
+        arr = self._as_host_array(value)
+        if arr is None:
+            from ray_tpu.core import serialization
+
+            blob = serialization.dumps(value)
+            payload = _META.pack(_KIND_PICKLE, len(blob)) + blob
+            slot._write_payload(payload, timeout)
+            self._wcursor += 1
+            return
+        header = pickle.dumps((arr.dtype.str, arr.shape))
+        total = _META.size + len(header) + arr.nbytes
+        if total > self.capacity:
+            raise ValueError(
+                f"array of {arr.nbytes} bytes exceeds device-channel "
+                f"capacity {self.capacity}")
+        # Write header+buffer directly into the slot's shm region — the
+        # device→host DMA result lands once, no pickle copy.
+        slot._wait_writable(timeout)
+        base = HEADER_SIZE
+        mm = slot._mm
+        _META.pack_into(mm, base, _KIND_ARRAY, len(header))
+        mm[base + _META.size:base + _META.size + len(header)] = header
+        off = base + _META.size + len(header)
+        dst = np.frombuffer(memoryview(mm)[off:off + arr.nbytes],
+                            dtype=np.uint8)
+        dst[:] = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        slot._publish(total)
+        self._wcursor += 1
+
+    @staticmethod
+    def _as_host_array(value) -> Optional[np.ndarray]:
+        """Host ndarray for array-likes; None for everything else.
+        jax.Arrays start their device→host DMA here (np.asarray blocks
+        until the transfer lands — by then the PREVIOUS slot's write is
+        already visible to the reader, which is the overlap)."""
+        try:
+            import jax
+
+            if isinstance(value, jax.Array):
+                return np.asarray(value)
+        except ImportError:  # pragma: no cover - jax is a hard dep
+            pass
+        if isinstance(value, np.ndarray):
+            return value
+        return None
+
+    # -- read ----------------------------------------------------------------
+
+    def read(self, timeout: Optional[float] = 30.0) -> Any:
+        """Next value as a ``jax.Array`` on this channel's device/sharding
+        (raw arrays) or the pickled object (control payloads)."""
+        self._complete_pending_ack()
+        slot = self._slots[self._rcursor % 2]
+        self._rcursor += 1
+        view, length = slot._read_view(timeout)
+        kind, hlen = _META.unpack_from(view, 0)
+        if kind == _KIND_PICKLE:
+            from ray_tpu.core import serialization
+
+            blob = bytes(view[_META.size:_META.size + hlen])
+            slot._ack_current()
+            value = serialization.loads(blob)
+            if isinstance(value, bytes) and value == _CLOSE_SENTINEL:
+                raise ChannelClosed(self.name)
+            return value
+        dtype_str, shape = pickle.loads(
+            bytes(view[_META.size:_META.size + hlen]))
+        off = _META.size + hlen
+        host = np.frombuffer(view[off:length], dtype=np.dtype(dtype_str))
+        host = host.reshape(shape)
+        import jax
+
+        if self._sharding is not None:
+            dev_arr = jax.device_put(host, self._sharding)
+        elif self._device is not None:
+            dev_arr = jax.device_put(host, self._device)
+        else:
+            dev_arr = jax.device_put(host)
+        # DEFERRED ack: the host→device upload may still be reading the
+        # shm bytes; ack only once it lands — usually on the NEXT read,
+        # by which point the writer has been filling the other slot.
+        self._pending_ack = (slot, dev_arr)
+        return dev_arr
+
+    def _complete_pending_ack(self) -> None:
+        if self._pending_ack is None:
+            return
+        slot, dev_arr = self._pending_ack
+        self._pending_ack = None
+        try:
+            dev_arr.block_until_ready()
+        except Exception:  # noqa: BLE001 — deleted/donated array: DMA done
+            pass
+        slot._ack_current()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        from ray_tpu.core import serialization
+
+        slot = self._slots[self._wcursor % 2]
+        self._wcursor += 1
+        blob = serialization.dumps(_CLOSE_SENTINEL)
+        payload = _META.pack(_KIND_PICKLE, len(blob)) + blob
+        try:
+            slot._write_payload(payload, timeout=0.5)
+        except (ChannelTimeout, ValueError):
+            # Force-publish the META-FRAMED pill (the raw underlying pill
+            # would be misparsed by this channel's framed read path).
+            slot._force_publish(payload)
+
+    def destroy(self) -> None:
+        self._complete_pending_ack()
+        for s in self._slots:
+            s.destroy()
+
+    def __reduce__(self):
+        return (DeviceChannel, (self.name, self.capacity, False,
+                                self._device, self._sharding))
+
+
+_CLOSE_SENTINEL = b"\x00__ray_tpu_device_channel_closed__"

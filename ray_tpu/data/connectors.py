@@ -294,6 +294,17 @@ def write_parquet_partitioned(ds: Dataset, root: str, *,
     import pyarrow.parquet as pq
 
     writers: Dict[tuple, pq.ParquetWriter] = {}
+    part_idx: Dict[tuple, int] = {}
+
+    def open_writer(key: tuple, schema) -> pq.ParquetWriter:
+        d = os.path.join(root, *(f"{c}={v}" for c, v in
+                                 zip(partition_cols, key)))
+        os.makedirs(d, exist_ok=True)
+        i = part_idx.get(key, 0)
+        part_idx[key] = i + 1
+        return pq.ParquetWriter(
+            os.path.join(d, f"part-{i:05d}.parquet"), schema)
+
     try:
         for block in ds.iter_blocks():
             # Per-block grouping only (bounded memory): rows of this block
@@ -308,12 +319,17 @@ def write_parquet_partitioned(ds: Dataset, root: str, *,
                 table = BlockAccessor.from_items(rows)
                 w = writers.get(key)
                 if w is None:
-                    d = os.path.join(root, *(f"{c}={v}" for c, v in
-                                             zip(partition_cols, key)))
-                    os.makedirs(d, exist_ok=True)
-                    w = pq.ParquetWriter(
-                        os.path.join(d, "part-00000.parquet"), table.schema)
-                    writers[key] = w
+                    w = writers[key] = open_writer(key, table.schema)
+                if not table.schema.equals(w.schema):
+                    # Per-block type inference can disagree (int64 block
+                    # then double block): cast when possible, else roll a
+                    # NEW part file with the new schema — readers merge
+                    # all parts, so no rows are lost either way.
+                    try:
+                        table = table.cast(w.schema)
+                    except pa.ArrowInvalid:
+                        w.close()
+                        w = writers[key] = open_writer(key, table.schema)
                 w.write_table(table)
     finally:
         for w in writers.values():

@@ -39,8 +39,99 @@ _REDUCE_OPS = {
 }
 
 
+def _device_allreduce(slots: Dict[int, "object"], op: str, world: int):
+    """Compiled allreduce over the DEVICES the ranks' arrays already live
+    on: a 1-D mesh is built from those devices, the per-rank buffers are
+    assembled into one global array (``make_array_from_single_device_
+    arrays`` — no host round trip), and a jitted ``shard_map`` psum/pmax/
+    pmin reduces over the mesh axis. Each rank gets its result shard back
+    ON ITS OWN DEVICE — the single-host multi-chip tier of §5.8 (the
+    NCCL-group analog; on TPU hardware the reduction rides ICI)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ranks = sorted(slots)
+    arrs = [slots[r] for r in ranks]
+    devices = []
+    for a in arrs:
+        ds = list(a.devices()) if hasattr(a, "devices") else []
+        devices.append(ds[0] if len(ds) == 1 else None)
+    distinct = (all(d is not None for d in devices)
+                and len(set(devices)) == len(devices))
+    if not distinct:
+        # Co-located (or host) inputs: still a compiled reduction, just on
+        # one device — the mesh path needs one device per rank.
+        stacked = jnp.stack([jnp.asarray(a) for a in arrs])
+        red = _jnp_reduce(op, stacked, world)
+        return {r: red for r in ranks}
+
+    mesh_devices = tuple(devices)
+    expanded = [a[None] for a in arrs]  # computed on each rank's device
+    mesh = Mesh(list(mesh_devices), ("r",))
+    global_arr = jax.make_array_from_single_device_arrays(
+        (len(arrs),) + tuple(arrs[0].shape),
+        NamedSharding(mesh, P("r")),
+        expanded)
+    fn = _device_allreduce_fn(mesh_devices, op, world)
+    out = fn(global_arr)
+    per = {}
+    for shard in out.addressable_shards:
+        idx = devices.index(shard.device)
+        per[ranks[idx]] = shard.data[0]
+    return per
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _device_allreduce_fn(mesh_devices: tuple, op: str, world: int):
+    """Jitted shard_map reduction, cached by (devices, op, world) — jit's
+    own cache is keyed on function identity, so a fresh closure per call
+    would retrace+recompile every allreduce."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(list(mesh_devices), ("r",))
+
+    def body(s):
+        if op == "sum":
+            return lax.psum(s, "r")
+        if op == "mean":
+            return lax.psum(s, "r") / world
+        if op == "max":
+            return lax.pmax(s, "r")
+        if op == "min":
+            return lax.pmin(s, "r")
+        g = lax.all_gather(s, "r", axis=0, tiled=True)
+        return jnp.prod(g, axis=0, keepdims=True)
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("r"),
+                                 out_specs=P("r"), check_vma=False))
+
+
+@functools.lru_cache(maxsize=16)
+def _jnp_reduce_fn(op: str):
+    import jax
+    import jax.numpy as jnp
+
+    fns = {"sum": jnp.sum, "prod": jnp.prod, "min": jnp.min,
+           "max": jnp.max, "mean": jnp.mean}
+    return jax.jit(functools.partial(fns[op], axis=0))
+
+
+def _jnp_reduce(op: str, stacked, world: int):
+    return _jnp_reduce_fn(op)(stacked)
+
+
 class _GroupState:
     """Shared rendezvous state for one collective group (local backend)."""
+
+    backend = "local"
 
     def __init__(self, world_size: int):
         self.world_size = world_size
@@ -109,6 +200,25 @@ class _GroupState:
                 if not self.cv.wait(timeout=timeout):
                     raise TimeoutError(f"recv from rank {src} timed out")
             return self.p2p[key].pop(0)
+
+
+class _DeviceGroupState(_GroupState):
+    """In-process group whose allreduce runs COMPILED on the ranks' own
+    devices (``backend="device"``). Broadcast/allgather hand device arrays
+    through untouched; reducescatter/alltoall fall back to the host
+    compute (their payloads coerce via numpy)."""
+
+    backend = "device"
+
+    def exchange_desc(self, rank: int, descriptor: tuple, value):
+        if descriptor[0] == "allreduce":
+            op = descriptor[1]
+            per = self.exchange(
+                rank, value,
+                lambda slots: _device_allreduce(slots, op, self.world_size))
+            return per[rank]
+        return self.exchange(rank, value,
+                             _compute_for(descriptor, self.world_size))
 
 
 def _compute_for(descriptor: tuple, world: int):
@@ -345,21 +455,30 @@ class _DistributedGroup:
                 and isinstance(value, np.ndarray)
                 and value.nbytes >= self.SHM_MIN_BYTES
                 and consumers > 0):
-            import os as _os
-
-            key = _os.urandom(16)
-            view = self._shm.create(key, value.nbytes)
-            if view is not None:
-                flat = np.frombuffer(view, dtype=value.dtype)
-                flat[:] = np.ascontiguousarray(value).reshape(-1)
-                self._shm.seal(key)
-                self._service.note_outstanding(key, consumers)
+            key = self._publish_shm(value, consumers)
+            if key is not None:
                 return self._peers.get(self._addrs[dst]).call_async(
                     "deliver_shm", tag, key, value.shape, value.dtype.str,
                     self.rank)
             # Arena full: fall through to the socket path.
         return self._peers.get(self._addrs[dst]).call_async(
             "deliver", tag, value)
+
+    def _publish_shm(self, arr: np.ndarray, consumers: int) -> Optional[bytes]:
+        """Seal one shm object holding ``arr``; returns its key (None when
+        the arena is full). The creator expects ``consumers`` acks before
+        deleting."""
+        import os as _os
+
+        key = _os.urandom(16)
+        view = self._shm.create(key, arr.nbytes)
+        if view is None:
+            return None
+        flat = np.frombuffer(view, dtype=arr.dtype)
+        flat[:] = np.ascontiguousarray(arr).reshape(-1)
+        self._shm.seal(key)
+        self._service.note_outstanding(key, consumers)
+        return key
 
     def _materialize(self, incoming):
         """(ndarray, holder) for a received chunk. shm-delivered chunks
@@ -545,15 +664,8 @@ class _DistributedGroup:
         if (children and key_holder is None and self._all_same_store
                 and self._shm is not None and isinstance(arr, np.ndarray)
                 and arr.nbytes >= self.SHM_MIN_BYTES):
-            import os as _os
-
-            key = _os.urandom(16)
-            view = self._shm.create(key, arr.nbytes)
-            if view is not None:
-                np.frombuffer(view, dtype=arr.dtype)[:] = (
-                    np.ascontiguousarray(arr).reshape(-1))
-                self._shm.seal(key)
-                self._service.note_outstanding(key, n - 1)
+            key = self._publish_shm(arr, n - 1)
+            if key is not None:
                 # Root-side pseudo-holder: carries the key for forwarding;
                 # the root itself never acks/closes it.
                 key_holder = _ShmIncoming(arr, key, self.rank, self._shm)
@@ -562,7 +674,7 @@ class _DistributedGroup:
                 futs.append(self._peers.get(
                     self._addrs[(src + child_rel) % n]).call_async(
                     "deliver_shm", (seq, "bc", child_rel), key_holder.key,
-                    arr.shape, arr.dtype.str, key_holder.origin, 0))
+                    arr.shape, arr.dtype.str, key_holder.origin))
             else:
                 futs.append(self._send_async(
                     (src + child_rel) % n, (seq, "bc", child_rel), arr))
@@ -679,31 +791,40 @@ def init_collective_group(
     rendezvouses through the process-wide registry (the analog of NCCL
     unique-id exchange via the reference's internal KV).
     """
-    if backend not in ("local", "gloo", "ring", "xla"):
+    if backend not in ("local", "gloo", "ring", "device", "xla"):
         raise ValueError(f"unknown backend {backend}")
     if backend == "xla":
-        # No silent fallback: eager DEVICE collectives require a live
-        # jax.distributed world (multi-host ICI/DCN), which this runtime
-        # wires through the mesh/Train layer, not the eager API. Anything
-        # else would quietly run host-side and misreport performance.
+        # No silent fallback: inside jit'ed programs device tensors already
+        # use XLA collectives over ICI via jax.sharding; the EAGER device
+        # tier is backend="device" (single-host multi-chip: a compiled
+        # psum over the devices the ranks' arrays live on). Multi-host
+        # eager device collectives require a jax.distributed world, which
+        # this runtime wires through the mesh/Train layer.
         raise RuntimeError(
             "backend='xla' is the compiled path: device tensors inside "
             "jit'ed programs already use XLA collectives over ICI via "
             "jax.sharding (see ray_tpu.parallel.mesh / JaxTrainer). For "
-            "eager host-tensor collectives between actors use "
-            "backend='gloo' (ring over sockets) or 'local' (in-process).")
+            "eager collectives between actors use backend='device' "
+            "(same-host device arrays, compiled psum over their chips), "
+            "'gloo' (host tensors, ring over sockets) or 'local' "
+            "(in-process).")
     if backend in ("gloo", "ring"):
         _init_distributed_group(world_size, rank, group_name)
     else:
+        cls = _DeviceGroupState if backend == "device" else _GroupState
         with _groups_lock:
             state = _groups.get(group_name)
             if state is None:
-                state = _GroupState(world_size)
+                state = cls(world_size)
                 _groups[group_name] = state
             elif state.world_size != world_size:
                 raise ValueError(
                     f"group {group_name} exists with world_size={state.world_size}"
                 )
+            elif type(state) is not cls:
+                raise ValueError(
+                    f"group {group_name} exists with backend="
+                    f"{state.backend!r}")
     with _groups_lock:
         _ranks.setdefault(_ctx_key(), {})[group_name] = rank
     # Record membership in the control plane for observability.
@@ -832,13 +953,21 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _prep(state, tensor):
+    """Device-backend groups keep tensors ON DEVICE; host backends get
+    numpy (the reference's gloo path copies to host the same way)."""
+    if getattr(state, "backend", "local") == "device":
+        return tensor
+    return _to_numpy(tensor)
+
+
 def allreduce(tensor, op: str = "sum", group_name: str = "default"):
     """reference: collective.py:258."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"unknown reduce op {op}")
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange_desc(rank, ("allreduce", op), _to_numpy(tensor))
+    return state.exchange_desc(rank, ("allreduce", op), _prep(state, tensor))
 
 
 def barrier(group_name: str = "default") -> None:
@@ -851,7 +980,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     """reference: collective.py:373."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    value = _to_numpy(tensor) if rank == src_rank else None
+    value = _prep(state, tensor) if rank == src_rank else None
     return state.exchange_desc(rank, ("broadcast", src_rank), value)
 
 
@@ -859,7 +988,7 @@ def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     """reference: collective.py:423. Returns list of per-rank tensors."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange_desc(rank, ("allgather",), _to_numpy(tensor))
+    return state.exchange_desc(rank, ("allgather",), _prep(state, tensor))
 
 
 def reducescatter(tensor, op: str = "sum", group_name: str = "default"):

@@ -10,6 +10,11 @@ from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
 from ray_tpu.rllib.impala import IMPALA, AggregatorActor, ImpalaConfig, ImpalaLearner, vtrace
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.offline import BC, MARWIL, BCConfig, MARWILConfig, episodes_to_dataset
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, nstep_columns
@@ -49,4 +54,7 @@ __all__ = [
     "BCConfig",
     "MARWILConfig",
     "episodes_to_dataset",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
 ]

@@ -223,3 +223,71 @@ class TestCollectiveRoundStress:
         expected = [6.0 * ((2 + i % 3) * 4) for i in range(40)]
         assert results[0] == expected
         collectives.destroy_collective_group("stress")
+
+
+def test_device_backend_allreduce_stays_on_device():
+    """backend="device": the eager NCCL-tier analog (§5.8) — actor-held
+    DEVICE arrays are reduced by a COMPILED psum over the devices they
+    already live on; each rank's result lands on its own device, no host
+    round trip. Exercised over 4 of the virtual CPU devices."""
+    import threading
+
+    from ray_tpu.parallel import collectives as col
+
+    world = 4
+    devices = jax.devices()[:world]
+    results = {}
+    errors = []
+
+    def member(rank):
+        try:
+            col.init_collective_group(world, rank, backend="device",
+                                      group_name="dev-g")
+            x = jax.device_put(
+                jnp.full((8,), float(rank + 1)), devices[rank])
+            out = col.allreduce(x, op="sum", group_name="dev-g")
+            results[rank] = out
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    expect = sum(range(1, world + 1))  # 1+2+3+4
+    for rank in range(world):
+        out = results[rank]
+        assert isinstance(out, jax.Array)
+        np.testing.assert_allclose(np.asarray(out), np.full((8,), expect))
+        # The result shard lives on the rank's OWN device.
+        assert list(out.devices())[0] == devices[rank], (
+            rank, out.devices())
+    col.destroy_collective_group("dev-g")
+
+
+def test_device_backend_mean_and_colocated_fallback():
+    import threading
+
+    from ray_tpu.parallel import collectives as col
+
+    world = 2
+    dev = jax.devices()[0]  # BOTH ranks on one device: compiled fallback
+    results = {}
+
+    def member(rank):
+        col.init_collective_group(world, rank, backend="device",
+                                  group_name="dev-co")
+        x = jax.device_put(jnp.full((4,), float(rank)), dev)
+        results[rank] = col.allreduce(x, op="mean", group_name="dev-co")
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for rank in range(world):
+        np.testing.assert_allclose(np.asarray(results[rank]),
+                                   np.full((4,), 0.5))
+    col.destroy_collective_group("dev-co")

@@ -873,3 +873,133 @@ class TestOffline:
         buf.update_priorities(s["indices"], np.abs(s["obs"][:, 0]) + 0.1)
         s2 = buf.sample(16)
         assert s2["obs"].shape == (16, 1)
+
+
+class _TwoAgentBitEnv:
+    """Cooperative test env on the MultiAgentEnv dict contract: each agent
+    observes a 4-dim context encoding a target bit; reward 1 for matching
+    it. Agent a1's bit is the NEGATION of a0's, so a shared policy cannot
+    ace both — per-agent policies must specialize."""
+
+    action_space_n = 2
+
+    def __init__(self, episode_len=16, seed=0):
+        self._len = episode_len
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._bit = 0
+
+    def _obs(self):
+        b0 = float(self._bit)
+        return {
+            "a0": np.array([b0, 1 - b0, 1.0, 0.0], np.float32),
+            "a1": np.array([b0, 1 - b0, 0.0, 1.0], np.float32),
+        }
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._bit = int(self._rng.integers(0, 2))
+        return self._obs(), {}
+
+    def step(self, actions):
+        rewards = {
+            "a0": float(actions["a0"] == self._bit),
+            "a1": float(actions["a1"] == 1 - self._bit),
+        }
+        self._t += 1
+        done = self._t >= self._len
+        self._bit = int(self._rng.integers(0, 2))
+        terms = {"a0": done, "a1": done, "__all__": done}
+        truncs = {"a0": False, "a1": False, "__all__": False}
+        return self._obs(), rewards, terms, truncs, {}
+
+    def close(self):
+        pass
+
+
+class TestMultiAgent:
+    def _policies(self):
+        from ray_tpu.rllib import RLModuleSpec
+
+        spec = RLModuleSpec(observation_dim=4, action_dim=2, hidden=(32,))
+        return {"p0": spec, "p1": spec}
+
+    def test_runner_groups_by_policy(self, ray_start_regular):
+        from ray_tpu.rllib.multi_agent import MultiAgentEnvRunner
+
+        runner = MultiAgentEnvRunner(
+            lambda: _TwoAgentBitEnv(episode_len=8),
+            policies=self._policies(),
+            policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1",
+            seed=0)
+        out = runner.sample(24)
+        trajs = out["trajectories"]
+        assert set(trajs) == {"p0", "p1"}
+        assert trajs["p0"] and trajs["p1"]
+        total = sum(len(t["rewards"]) for t in trajs["p0"])
+        assert total == 24  # one agent per policy, one step per env step
+        assert out["num_episodes"] >= 2  # 24 steps / 8-step episodes
+        t = trajs["p0"][0]
+        assert t["obs"].shape[1] == 4
+        assert len(t["actions"]) == len(t["logp"]) == len(t["values"])
+
+    def test_multi_agent_ppo_learns_both_policies(self, ray_start_regular):
+        """Learning gate: per-agent policies must specialize (a1's target
+        is the negation of a0's) and lift the joint return toward the
+        32-per-episode max."""
+        from ray_tpu.rllib import MultiAgentPPOConfig
+
+        algo = (MultiAgentPPOConfig()
+                .environment(lambda: _TwoAgentBitEnv(episode_len=16))
+                .multi_agent(
+                    policies=self._policies(),
+                    policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1")
+                .training(rollout_fragment_length=256, num_sgd_iter=4,
+                          minibatch_size=64, lr=3e-3, entropy_coeff=0.0,
+                          seed=0)
+                .build())
+        try:
+            first, best = None, -np.inf
+            for _ in range(12):
+                r = algo.train()
+                ret = r["episode_return_mean"]
+                if not np.isnan(ret):
+                    first = ret if first is None else first
+                    best = max(best, ret)
+                if best >= 28.0:
+                    break
+            # Random play averages 16 (half right); learned play nears 32.
+            assert best >= 26.0, (first, best)
+        finally:
+            algo.stop()
+
+    def test_multi_agent_checkpoint_roundtrip(self, ray_start_regular, tmp_path):
+        from ray_tpu.rllib import MultiAgentPPOConfig
+
+        def build(seed):
+            return (MultiAgentPPOConfig()
+                    .environment(lambda: _TwoAgentBitEnv(episode_len=8))
+                    .multi_agent(
+                        policies=self._policies(),
+                        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1")
+                    .training(rollout_fragment_length=32, seed=seed)
+                    .build())
+
+        algo = build(0)
+        try:
+            algo.train()
+            path = algo.save(str(tmp_path / "ma_ck"))
+            algo2 = build(9)
+            try:
+                algo2.restore(path)
+                for pid in ("p0", "p1"):
+                    for a, b in zip(
+                            jax.tree.leaves(algo.learners[pid].get_weights()),
+                            jax.tree.leaves(algo2.learners[pid].get_weights())):
+                        np.testing.assert_array_equal(a, b)
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()
