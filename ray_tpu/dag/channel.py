@@ -182,12 +182,15 @@ class Channel:
     def destroy(self) -> None:
         try:
             self._mm.close()
-            self._f.close()
         except (OSError, BufferError):
             # BufferError: a zero-copy view handed out by _read_view is
             # still referenced (e.g. a device array's source buffer whose
             # consumer hasn't been collected yet) — the mmap closes when
             # the last view dies; unlink the backing file regardless.
+            pass
+        try:
+            self._f.close()  # its own try: the fd must not leak when
+        except OSError:      # mm.close() raised above
             pass
         try:
             os.unlink(f"/dev/shm/{self.name}")

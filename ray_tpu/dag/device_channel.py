@@ -86,14 +86,20 @@ class DeviceChannel:
         # Write header+buffer directly into the slot's shm region — the
         # device→host DMA result lands once, no pickle copy.
         slot._wait_writable(timeout)
-        base = HEADER_SIZE
-        mm = slot._mm
-        _META.pack_into(mm, base, _KIND_ARRAY, len(header))
-        mm[base + _META.size:base + _META.size + len(header)] = header
-        off = base + _META.size + len(header)
-        dst = np.frombuffer(memoryview(mm)[off:off + arr.nbytes],
-                            dtype=np.uint8)
-        dst[:] = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        try:
+            base = HEADER_SIZE
+            mm = slot._mm
+            _META.pack_into(mm, base, _KIND_ARRAY, len(header))
+            mm[base + _META.size:base + _META.size + len(header)] = header
+            off = base + _META.size + len(header)
+            dst = np.frombuffer(memoryview(mm)[off:off + arr.nbytes],
+                                dtype=np.uint8)
+            dst[:] = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        except BaseException:
+            # Roll the seqlock back to even: a failed fill must not leave
+            # the slot marked write-in-progress forever.
+            slot._store_write_seq(slot._pending_write_seq)
+            raise
         slot._publish(total)
         self._wcursor += 1
 
@@ -110,7 +116,9 @@ class DeviceChannel:
                 return np.asarray(value)
         except ImportError:  # pragma: no cover - jax is a hard dep
             pass
-        if isinstance(value, np.ndarray):
+        if isinstance(value, np.ndarray) and value.dtype != object:
+            # object-dtype arrays hold pointers — raw bytes would be
+            # garbage cross-process; they take the pickled path.
             return value
         return None
 
@@ -121,13 +129,18 @@ class DeviceChannel:
         (raw arrays) or the pickled object (control payloads)."""
         self._complete_pending_ack()
         slot = self._slots[self._rcursor % 2]
-        self._rcursor += 1
         view, length = slot._read_view(timeout)
+        self._rcursor += 1  # only after a value arrived (cursor-on-success)
         kind, hlen = _META.unpack_from(view, 0)
         if kind == _KIND_PICKLE:
             from ray_tpu.core import serialization
 
             blob = bytes(view[_META.size:_META.size + hlen])
+            if slot._load()[0] != slot._pending_read_seq:
+                # close() force-published over the slot mid-copy; the only
+                # force-publisher is teardown.
+                slot._ack_current()
+                raise ChannelClosed(self.name)
             slot._ack_current()
             value = serialization.loads(blob)
             if isinstance(value, bytes) and value == _CLOSE_SENTINEL:
@@ -149,18 +162,24 @@ class DeviceChannel:
         # DEFERRED ack: the host→device upload may still be reading the
         # shm bytes; ack only once it lands — usually on the NEXT read,
         # by which point the writer has been filling the other slot.
-        self._pending_ack = (slot, dev_arr)
+        self._pending_ack = (slot, dev_arr, slot._pending_read_seq)
         return dev_arr
 
     def _complete_pending_ack(self) -> None:
         if self._pending_ack is None:
             return
-        slot, dev_arr = self._pending_ack
+        slot, dev_arr, seq = self._pending_ack
         self._pending_ack = None
         try:
             dev_arr.block_until_ready()
         except Exception:  # noqa: BLE001 — deleted/donated array: DMA done
             pass
+        if slot._load()[0] != seq:
+            # A teardown force-publish overwrote the slot while the upload
+            # was in flight — the consumer's tensor may be torn. Surface
+            # it as the close it is rather than silent corruption.
+            slot._ack_current()
+            raise ChannelClosed(self.name)
         slot._ack_current()
 
     # -- lifecycle -----------------------------------------------------------

@@ -327,7 +327,10 @@ def write_parquet_partitioned(ds: Dataset, root: str, *,
                     # all parts, so no rows are lost either way.
                     try:
                         table = table.cast(w.schema)
-                    except pa.ArrowInvalid:
+                    except (pa.ArrowInvalid, pa.ArrowTypeError,
+                            pa.ArrowNotImplementedError, ValueError):
+                        # cast raises ValueError (not ArrowInvalid) on
+                        # field-name/count mismatches — the common case.
                         w.close()
                         w = writers[key] = open_writer(key, table.schema)
                 w.write_table(table)

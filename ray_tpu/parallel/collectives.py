@@ -64,7 +64,7 @@ def _device_allreduce(slots: Dict[int, "object"], op: str, world: int):
         # Co-located (or host) inputs: still a compiled reduction, just on
         # one device — the mesh path needs one device per rank.
         stacked = jnp.stack([jnp.asarray(a) for a in arrs])
-        red = _jnp_reduce(op, stacked, world)
+        red = _jnp_reduce_fn(op)(stacked)
         return {r: red for r in ranks}
 
     mesh_devices = tuple(devices)
@@ -122,10 +122,6 @@ def _jnp_reduce_fn(op: str):
     fns = {"sum": jnp.sum, "prod": jnp.prod, "min": jnp.min,
            "max": jnp.max, "mean": jnp.mean}
     return jax.jit(functools.partial(fns[op], axis=0))
-
-
-def _jnp_reduce(op: str, stacked, world: int):
-    return _jnp_reduce_fn(op)(stacked)
 
 
 class _GroupState:

@@ -5,6 +5,8 @@ Learner / LearnerGroup / EnvRunner; old Policy/RolloutWorker stack explicitly
 not ported — SURVEY §7 "do NOT port").
 """
 
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
+from ray_tpu.rllib.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
@@ -57,4 +59,10 @@ __all__ = [
     "MultiAgentEnvRunner",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
+    "CQL",
+    "CQLConfig",
+    "CQLLearner",
 ]

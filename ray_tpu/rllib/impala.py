@@ -112,11 +112,17 @@ class ImpalaLearner(Learner):
             valids=valids,
         )
         w = valids / jnp.maximum(valids.sum(), 1.0)
-        pg_loss = -jnp.sum(logp * jax.lax.stop_gradient(pg_adv) * w)
+        pg_loss = self._pg_loss(logp, batch["logp"],
+                                jax.lax.stop_gradient(pg_adv), w)
         vf_loss = 0.5 * jnp.sum((values - jax.lax.stop_gradient(vs)) ** 2 * w)
         ent = jnp.sum(entropy * w)
         return (pg_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss
                 - cfg.get("entropy_coeff", 0.01) * ent)
+
+    def _pg_loss(self, logp, behavior_logp, adv, w):
+        """Plain policy gradient over the v-trace advantages; APPO swaps
+        in the clipped surrogate."""
+        return -jnp.sum(logp * adv * w)
 
 
 class AggregatorActor:
@@ -167,6 +173,20 @@ class ImpalaConfig(AlgorithmConfigBase):
 class IMPALA:
     """Async actor-learner algorithm (Tune-compatible train() contract)."""
 
+    # Subclasses (APPO) swap the learner while reusing the async
+    # sample/aggregate/update machinery (the reference's APPO subclasses
+    # IMPALA the same way, rllib/algorithms/appo/appo.py).
+    _LEARNER_CLS = ImpalaLearner
+
+    def _learner_config(self, config) -> Dict[str, Any]:
+        return {
+            "lr": config.lr, "gamma": config.gamma,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
+            "rho_bar": config.rho_bar, "c_bar": config.c_bar,
+            "grad_clip": config.grad_clip,
+        }
+
     def __init__(self, config: ImpalaConfig):
         assert config.env is not None, "config.environment(env_creator) required"
         self.config = config
@@ -179,13 +199,7 @@ class IMPALA:
                                             hidden=tuple(config.hidden))
         probe.close()
 
-        learner_cfg = {
-            "lr": config.lr, "gamma": config.gamma,
-            "vf_loss_coeff": config.vf_loss_coeff,
-            "entropy_coeff": config.entropy_coeff,
-            "rho_bar": config.rho_bar, "c_bar": config.c_bar,
-            "grad_clip": config.grad_clip,
-        }
+        learner_cfg = self._learner_config(config)
         if config.num_learners > 0:
             import uuid
 
@@ -194,7 +208,7 @@ class IMPALA:
             # [T, N] trajectory columns shard on the ENV axis so each
             # learner sees whole time series; [N, ...] bootstrap rows on 0.
             self.learner = LearnerGroup(
-                ImpalaLearner, self.spec, learner_cfg,
+                type(self)._LEARNER_CLS, self.spec, learner_cfg,
                 num_learners=config.num_learners,
                 group_name=f"impala-learners-{uuid.uuid4().hex[:8]}",
                 seed=config.seed,
@@ -203,8 +217,8 @@ class IMPALA:
                             "bootstrap_obs": 0, "bootstrap_value": 0},
             )
         else:
-            self.learner = ImpalaLearner(self.spec, learner_cfg,
-                                         seed=config.seed)
+            self.learner = type(self)._LEARNER_CLS(self.spec, learner_cfg,
+                                                   seed=config.seed)
 
         runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self._runners = [

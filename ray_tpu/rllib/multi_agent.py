@@ -64,6 +64,11 @@ class MultiAgentEnvRunner:
         self._seed = seed
         self._episode = 0
         self._completed_returns: List[float] = []
+        # Episode state persists ACROSS sample() calls (like the
+        # single-agent runner's self._obs): episodes longer than one
+        # fragment continue where the previous fragment stopped.
+        self._obs: Optional[Dict[str, Any]] = None
+        self._ep_return = 0.0
 
     # -- weights sync ---------------------------------------------------------
 
@@ -85,9 +90,10 @@ class MultiAgentEnvRunner:
         single-agent path applies)."""
         open_trajs: Dict[str, Dict[str, list]] = {}
         done_trajs: Dict[str, List[dict]] = {p: [] for p in self._policies}
-        ep_return = 0.0
 
-        obs, _ = self._env.reset(seed=self._seed + self._episode)
+        if self._obs is None:
+            self._obs, _ = self._env.reset(seed=self._seed + self._episode)
+        obs = self._obs
         for _ in range(num_env_steps):
             # Group live agents by policy; one batched forward per policy.
             by_policy: Dict[str, List[str]] = {}
@@ -123,7 +129,7 @@ class MultiAgentEnvRunner:
                 t["values"].append(va)
                 r = float(rewards.get(agent, 0.0))
                 t["rewards"].append(r)
-                ep_return += r
+                self._ep_return += r
 
             episode_over = bool(terms.get("__all__") or truncs.get("__all__"))
             for agent in list(open_trajs):
@@ -132,13 +138,14 @@ class MultiAgentEnvRunner:
                     self._finalize(open_trajs.pop(agent), terminated,
                                    next_obs.get(agent), done_trajs)
             if episode_over:
-                self._completed_returns.append(ep_return)
-                ep_return = 0.0
+                self._completed_returns.append(self._ep_return)
+                self._ep_return = 0.0
                 self._episode += 1
                 obs, _ = self._env.reset(seed=self._seed + self._episode)
             else:
                 obs = next_obs
 
+        self._obs = obs  # episode continues in the next fragment
         # Cut still-open segments at the fragment boundary (bootstrapped).
         for agent in list(open_trajs):
             self._finalize(open_trajs.pop(agent), False, obs.get(agent),

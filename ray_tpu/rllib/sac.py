@@ -142,6 +142,10 @@ class SACLearner:
         self._step_fn = jax.jit(self._step)
         self._updates = 0
 
+    def _conservative_penalty(self, qp, params, batch, key):
+        """0 for plain SAC; CQL overrides with the logsumexp penalty."""
+        return 0.0
+
     def _step(self, params, target_q, log_alpha, pi_state, q_state, a_state,
               batch, key):
         m = self.module
@@ -163,6 +167,8 @@ class SACLearner:
             q2 = m.q_value(qp["q2"], batch["obs"], batch["actions"])
             w = batch["weights"]
             loss = jnp.mean(w * ((q1 - y) ** 2 + (q2 - y) ** 2))
+            # Subclass hook (CQL): conservative regularizer on OOD actions.
+            loss = loss + self._conservative_penalty(qp, params, batch, k1)
             return loss, q1 - y
 
         qp = {"q1": params["q1"], "q2": params["q2"]}
