@@ -117,15 +117,19 @@ class Cluster:
         self.gcs_proc.send_signal(sig)
         self.gcs_proc.wait(timeout=10)
 
-    def restart_gcs(self) -> None:
+    def restart_gcs(self, restore_from: str | None = None) -> None:
         """Head restart: rebuild tables from the snapshot (GCS FT path —
         ``gcs_server.cc:523-524`` Redis-backed restart analog). Rebinds the
-        SAME port so daemons/drivers reconnect without re-discovery."""
+        SAME port so daemons/drivers reconnect without re-discovery.
+        ``restore_from``: a daemon address holding a snapshot MIRROR — the
+        head-DISK-loss path (local snapshot gone)."""
         port = self.gcs_address.rsplit(":", 1)[1]
         gcs_cmd = [sys.executable, "-m", "ray_tpu.core.gcs_server",
                    "--port", port]
         if self._snapshot_path:
             gcs_cmd += ["--snapshot", self._snapshot_path]
+        if restore_from:
+            gcs_cmd += ["--restore-from", restore_from]
         self.gcs_proc = subprocess.Popen(
             gcs_cmd, stdout=subprocess.PIPE, env=self._env
         )

@@ -56,6 +56,10 @@ class Config:
     object_store_memory = _Flag(2 * 1024 * 1024 * 1024)
     # Spill directory for objects evicted from the shm store.
     object_spilling_dir = _Flag("/tmp/ray_tpu_spill")
+    # GCS snapshots are mirrored to this many node daemons per tick, so a
+    # fresh head can restore after losing its DISK (the external-Redis
+    # role of gcs_server.cc:523-524). 0 disables mirroring.
+    gcs_snapshot_mirrors = _Flag(2)
     # Use the native C++ shared-memory arena for large object buffers
     # (the plasma path; falls back to heap bytes when the lib can't build).
     use_native_store = _Flag(True)

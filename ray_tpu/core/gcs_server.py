@@ -90,7 +90,8 @@ class _PlacementGroup:
 class GcsService:
     """The RPC handler: every public method is a control-plane RPC."""
 
-    def __init__(self, snapshot_path: str | None = None):
+    def __init__(self, snapshot_path: str | None = None,
+                 restore_from: str | None = None):
         self.store = GlobalControlStore()
         self.scheduler = ClusterResourceScheduler()
         self._lock = threading.RLock()
@@ -129,9 +130,17 @@ class GcsService:
         self._pub_log: Dict[str, List[Any]] = {}
         self._pub_base: Dict[str, int] = {}  # messages truncated off the front
         self._snapshot_path = snapshot_path
+        self._snapshot_seq = 0
         self._stopped = threading.Event()
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore_snapshot(snapshot_path)
+        elif restore_from:
+            # Head-disk-loss recovery: the local snapshot is gone, but the
+            # tables were MIRRORED to node daemons on every snapshot tick —
+            # pull the newest copy from any surviving daemon (the external-
+            # store role Redis plays in the reference,
+            # ``gcs_server.cc:523-524``).
+            self._restore_from_mirror(restore_from)
         self._monitor = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
@@ -902,11 +911,58 @@ class GcsService:
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, self._snapshot_path)
+        self._mirror_snapshot(data)
+
+    def _mirror_snapshot(self, data: bytes) -> None:
+        """Replicate the snapshot blob to up to ``gcs_snapshot_mirrors``
+        alive node daemons — surviving head-node DISK loss, not just head
+        process death (the role of the reference's external Redis store)."""
+        n = config().gcs_snapshot_mirrors
+        if n <= 0:
+            return
+        self._snapshot_seq += 1
+        with self._lock:
+            addrs = [addr for node_id, addr in self._node_addr.items()
+                     if node_id not in self._dead_nodes][:n]
+        for addr in addrs:
+            try:
+                self._daemons.get(addr).notify(
+                    "store_gcs_snapshot", self._snapshot_seq, data)
+            except Exception:  # noqa: BLE001 — mirror is best-effort
+                pass
+
+    def _restore_from_mirror(self, daemon_addr: str) -> None:
+        from ray_tpu.core.rpc import RpcClient
+
+        try:
+            client = RpcClient(daemon_addr)
+            result = client.call("fetch_gcs_snapshot", timeout=30.0)
+            client.close()
+        except Exception:
+            logger.exception("mirror restore from %s failed; starting fresh",
+                             daemon_addr)
+            return
+        if not result:
+            logger.warning("daemon %s holds no snapshot mirror", daemon_addr)
+            return
+        seq, blob = result
+        self._snapshot_seq = int(seq)
+        self._restore_snapshot_bytes(bytes(blob))
+        logger.info("restored tables from mirror on %s (seq %d)",
+                    daemon_addr, seq)
 
     def _restore_snapshot(self, path: str) -> None:
         try:
             with open(path, "rb") as f:
-                data = pickle.loads(f.read())
+                raw = f.read()
+        except Exception:
+            logger.exception("snapshot restore failed; starting fresh")
+            return
+        self._restore_snapshot_bytes(raw)
+
+    def _restore_snapshot_bytes(self, raw: bytes) -> None:
+        try:
+            data = pickle.loads(raw)
         except Exception:
             logger.exception("snapshot restore failed; starting fresh")
             return
@@ -998,8 +1054,10 @@ class GcsService:
 
 
 def serve(port: int = 0, host: str = "127.0.0.1",
-          snapshot_path: str | None = None) -> Tuple[GcsService, RpcServer]:
-    service = GcsService(snapshot_path=snapshot_path)
+          snapshot_path: str | None = None,
+          restore_from: str | None = None) -> Tuple[GcsService, RpcServer]:
+    service = GcsService(snapshot_path=snapshot_path,
+                         restore_from=restore_from)
     server = RpcServer(service, host=host, port=port, max_workers=128,
                        name="gcs")
     return service, server
@@ -1010,9 +1068,13 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--snapshot", default=None)
+    parser.add_argument("--restore-from", default=None,
+                        help="daemon address holding a snapshot mirror "
+                             "(head-disk-loss recovery)")
     args = parser.parse_args(argv)
     set_config(Config())
-    service, server = serve(args.port, args.host, args.snapshot)
+    service, server = serve(args.port, args.host, args.snapshot,
+                            args.restore_from)
     print(f"GCS_ADDRESS={server.address}", flush=True)
 
     stop = threading.Event()

@@ -373,9 +373,112 @@ class NodeDaemon:
                 _shutil.rmtree(env_dir, ignore_errors=True)
                 raise
 
+    def _ensure_conda_env(self, conda_spec) -> str:
+        """Resolve (or build) a conda env for a conda runtime env; returns
+        its python executable (the reference's conda plugin,
+        ``_private/runtime_env/conda.py``).
+
+        - str with a path separator: an env PREFIX — ``<prefix>/bin/python``
+          must exist (no conda binary needed; venv prefixes work too).
+        - other str: a NAMED env under ``$(conda info --base)/envs``.
+        - dict: an environment.yml body, built once into a cached prefix
+          keyed by spec hash (requires the conda binary).
+        """
+        import hashlib
+        import shutil as _shutil
+        import subprocess
+
+        def python_of(prefix: str) -> str:
+            py = os.path.join(prefix, "bin", "python")
+            if not os.path.exists(py):
+                raise RuntimeError(
+                    f"conda env prefix {prefix!r} has no bin/python")
+            return py
+
+        if isinstance(conda_spec, str):
+            if os.sep in conda_spec:
+                return python_of(os.path.abspath(conda_spec))
+            conda = _shutil.which("conda") or os.environ.get("CONDA_EXE")
+            if not conda:
+                raise RuntimeError(
+                    "runtime_env conda={name!r} needs the conda binary on "
+                    "this node (pass an env PREFIX path to use an existing "
+                    "environment without conda)".format(name=conda_spec))
+            base = subprocess.run([conda, "info", "--base"],
+                                  capture_output=True, text=True,
+                                  timeout=60).stdout.strip()
+            return python_of(os.path.join(base, "envs", conda_spec))
+
+        # dict: build a cached env from the yaml body.
+        conda = _shutil.which("conda") or os.environ.get("CONDA_EXE")
+        if not conda:
+            raise RuntimeError(
+                "runtime_env conda environments require the conda binary "
+                "on this node")
+        key = hashlib.sha1(json.dumps(conda_spec,
+                                      sort_keys=True).encode()).hexdigest()[:16]
+        prefix = os.path.join(self._pip_env_root(), f"conda-{key}")
+        if not os.path.exists(os.path.join(prefix, ".ready")):
+            import tempfile
+
+            import yaml  # type: ignore[import-untyped]
+
+            with tempfile.NamedTemporaryFile("w", suffix=".yml",
+                                             delete=False) as f:
+                yaml.safe_dump(conda_spec, f)
+                spec_path = f.name
+            out = subprocess.run(
+                [conda, "env", "create", "-p", prefix, "-f", spec_path],
+                capture_output=True, text=True, timeout=1800)
+            os.unlink(spec_path)
+            if out.returncode != 0:
+                _shutil.rmtree(prefix, ignore_errors=True)
+                raise RuntimeError(
+                    f"conda env create failed: {out.stderr[-1000:]}")
+            open(os.path.join(prefix, ".ready"), "w").close()
+        return python_of(prefix)
+
+    # Env keys forwarded INTO worker containers (docker doesn't inherit the
+    # daemon's environment the way a plain subprocess does).
+    _CONTAINER_ENV_PREFIXES = ("RAY_TPU_", "JAX_", "XLA_", "PALLAS_",
+                               "PYTHONPATH", "TPU_")
+
+    def _container_command(self, container_spec: Dict[str, Any],
+                           argv: List[str],
+                           env: Dict[str, str]) -> List[str]:
+        """Wrap a worker command to run inside a container (the reference's
+        container plugin, ``_private/runtime_env/container.py``): host
+        networking so the worker reaches the daemon/GCS sockets, /dev/shm
+        shared so the object-store arena stays visible, runtime-env keys
+        forwarded with ``-e``. The runtime binary comes from
+        ``container_spec["runtime"]``, ``$RAY_TPU_CONTAINER_RUNTIME``, or
+        podman/docker discovery."""
+        import shutil as _shutil
+
+        image = container_spec.get("image")
+        if not image:
+            raise RuntimeError("runtime_env container spec needs 'image'")
+        runtime = (container_spec.get("runtime")
+                   or os.environ.get("RAY_TPU_CONTAINER_RUNTIME")
+                   or _shutil.which("podman") or _shutil.which("docker"))
+        if not runtime:
+            raise RuntimeError(
+                "runtime_env container requires podman or docker on this "
+                "node (or RAY_TPU_CONTAINER_RUNTIME)")
+        cmd = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+               "-v", "/dev/shm:/dev/shm"]
+        for k, v in sorted(env.items()):
+            if k.startswith(self._CONTAINER_ENV_PREFIXES):
+                cmd += ["-e", f"{k}={v}"]
+        cmd += list(container_spec.get("run_options", []))
+        cmd.append(image)
+        cmd += argv
+        return cmd
+
     def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
                       env_key: Optional[str] = None,
-                      python_exe: Optional[str] = None) -> _Worker:
+                      python_exe: Optional[str] = None,
+                      container_spec: Optional[Dict[str, Any]] = None) -> _Worker:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         # CPU-only workers skip the TPU-runtime site hook: the axon
@@ -398,9 +501,15 @@ class NodeDaemon:
         log_path = os.path.join(self._log_dir,
                                 f"worker-{worker_id.hex()[:12]}.log")
         log_file = open(log_path, "ab", buffering=0)
+        argv = [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"]
+        if container_spec:
+            # Containerized workers run the image's `python` (the image
+            # carries its own interpreter + ray_tpu install).
+            argv = self._container_command(
+                container_spec, ["python", "-m", "ray_tpu.core.worker_main"],
+                env)
         proc = subprocess.Popen(
-            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            argv, env=env, stdout=log_file, stderr=subprocess.STDOUT,
         )
         log_file.close()  # the child holds its own fd
         worker = _Worker(worker_id, proc, env_key=env_key)
@@ -425,6 +534,9 @@ class NodeDaemon:
         python_exe = None
         if runtime_env.get("pip"):
             python_exe = self._ensure_pip_env(runtime_env["pip"])
+        if runtime_env.get("conda"):
+            python_exe = self._ensure_conda_env(runtime_env["conda"])
+        container_spec = runtime_env.get("container")
         key = json.dumps(runtime_env, sort_keys=True, default=str)
         deadline = time.time() + timeout
         with self._pool_cv:
@@ -432,7 +544,8 @@ class NodeDaemon:
             # gates the VANILLA pool only (a stuck dedicated spawn must not
             # starve ordinary tasks).
             worker = self._spawn_worker(env_vars, env_key=key,
-                                        python_exe=python_exe)
+                                        python_exe=python_exe,
+                                        container_spec=container_spec)
             try:
                 while worker.address is None:
                     if worker.proc.poll() is not None:
@@ -1069,6 +1182,33 @@ class NodeDaemon:
                 "lines": lines,
             })
         return batch
+
+    # -- GCS snapshot mirror (head-disk-loss HA; gcs_server._mirror_snapshot)
+
+    def store_gcs_snapshot(self, seq: int, blob: bytes) -> None:
+        """Keep the newest GCS snapshot replica on this node's disk."""
+        path = os.path.join(self._log_dir, "gcs_snapshot.mirror")
+        current = getattr(self, "_gcs_mirror_seq", -1)
+        if seq <= current:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(int(seq).to_bytes(8, "big"))
+            f.write(bytes(blob))
+        os.replace(tmp, path)
+        self._gcs_mirror_seq = seq
+
+    def fetch_gcs_snapshot(self):
+        """(seq, blob) of the newest mirrored GCS snapshot, or None."""
+        path = os.path.join(self._log_dir, "gcs_snapshot.mirror")
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        if len(raw) < 8:
+            return None
+        return int.from_bytes(raw[:8], "big"), raw[8:]
 
     def tail_worker_logs(self, max_bytes: int = 64 * 1024) -> Dict[str, str]:
         """Last chunk of every worker's log (state API / debugging)."""

@@ -21,11 +21,14 @@ from typing import Any, Dict, List, Optional
 _env_lock = threading.Lock()
 
 _SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda", "container"}
+# Isolation-requiring fields the IN-PROCESS runtime cannot honor (they need
+# a separate interpreter/namespace); the multiprocess daemon builds all
+# three (venv / conda prefix / container wrap — node_daemon.py).
 _DEFERRED = {"pip", "conda", "container"}
 # Fields that force a FRESH, dedicated worker process on the multiprocess
 # runtime (env at spawn / isolated interpreter). ONE definition — the
 # submit paths and the daemon all consult this.
-_DEDICATED = {"env_vars", "pip"}
+_DEDICATED = {"env_vars", "pip", "conda", "container"}
 
 
 def needs_dedicated_worker(env: Optional[Dict[str, Any]]) -> bool:
@@ -49,6 +52,13 @@ class RuntimeEnv(dict):
         if unknown:
             raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
         spec: Dict[str, Any] = dict(kwargs)
+        if "conda" in spec and not isinstance(spec["conda"], (str, dict)):
+            raise TypeError("conda must be an env name/prefix (str) or an "
+                            "environment.yml dict")
+        if "container" in spec:
+            if (not isinstance(spec["container"], dict)
+                    or not spec["container"].get("image")):
+                raise TypeError("container must be a dict with 'image'")
         if env_vars:
             if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
                 raise TypeError("env_vars must be Dict[str, str]")

@@ -163,3 +163,112 @@ def test_pip_runtime_env_builds_isolated_venv(tmp_path):
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_conda_prefix_runtime_env(tmp_path):
+    """runtime_env={"conda": <prefix path>}: the task runs under that
+    environment's interpreter (the conda plugin's existing-env path — a
+    venv prefix exercises it without the conda binary)."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.cluster import Cluster, connect
+
+    prefix = tmp_path / "condaish"
+    subprocess.run([sys.executable, "-m", "venv", "--system-site-packages",
+                    str(prefix)], check=True, timeout=300)
+    # Parent-env visibility (the daemon's pip builder writes the same .pth).
+    import sysconfig
+
+    site = subprocess.run(
+        [str(prefix / "bin" / "python"), "-c",
+         "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+        capture_output=True, text=True, timeout=60).stdout.strip()
+    with open(f"{site}/_rtpu_parent.pth", "w") as f:
+        f.write(sysconfig.get_paths()["purelib"] + "\n")
+
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote(runtime_env={"conda": str(prefix)})
+            def which_python():
+                import sys as _s
+
+                return _s.executable
+
+            exe = ray_tpu.get(which_python.remote(), timeout=300)
+            assert exe.startswith(str(prefix)), exe
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+
+
+def test_container_runtime_env_wraps_worker(tmp_path):
+    """runtime_env={"container": {...}}: the worker command is wrapped in
+    the container runtime with host networking and env passthrough. A fake
+    runtime (shim that records its argv, applies -e vars, and execs the
+    inner command) proves the wrapping end-to-end without docker."""
+    import os
+    import stat
+    import sys
+
+    import ray_tpu
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.cluster import Cluster, connect
+
+    record = tmp_path / "invocations.log"
+    shim = tmp_path / "fake-docker"
+    shim.write_text(f"""#!{sys.executable}
+import os, sys
+args = sys.argv[1:]
+with open({str(record)!r}, "a") as f:
+    f.write(" ".join(args) + "\\n")
+env = dict(os.environ)
+i = 1  # skip "run"
+cmd = None
+while i < len(args):
+    a = args[i]
+    if a == "-e":
+        k, _, v = args[i + 1].partition("="); env[k] = v; i += 2
+    elif a == "-v":
+        i += 2
+    elif a.startswith("-"):
+        i += 1
+    else:
+        cmd = args[i + 1:]  # args[i] is the image
+        break
+        i += 1
+cmd[0] = {sys.executable!r}  # the "image python" is this interpreter
+os.execvpe(cmd[0], cmd, env)
+""")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+
+    os.environ["RAY_TPU_CONTAINER_RUNTIME"] = str(shim)
+    cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 2})
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            @ray_tpu.remote(runtime_env={"container": {
+                "image": "example.com/rtpu:latest",
+                "run_options": ["--read-only"],
+            }})
+            def inside():
+                return "containerized-ok"
+
+            assert ray_tpu.get(inside.remote(), timeout=300) == "containerized-ok"
+            logged = record.read_text()
+            assert "example.com/rtpu:latest" in logged
+            assert "--network=host" in logged
+            assert "--read-only" in logged
+            assert "-e RAY_TPU_GCS_ADDRESS=" in logged
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_CONTAINER_RUNTIME", None)

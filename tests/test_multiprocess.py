@@ -848,3 +848,48 @@ def test_ring_collectives_full_surface():
             runtime_mod._global_runtime = None
     finally:
         cluster.shutdown()
+
+
+def test_gcs_head_disk_loss_restores_from_mirror(tmp_path):
+    """Head-DISK-loss recovery: snapshots are MIRRORED to node daemons
+    each tick; a fresh GCS whose local snapshot is gone restores from any
+    surviving daemon (the external-store role Redis plays in the
+    reference, gcs_server.cc:523-524)."""
+    snapshot = str(tmp_path / "gcs.snap")
+    cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 2},
+                      snapshot_path=snapshot)
+    try:
+        core = connect(cluster.gcs_address)
+        try:
+            core.gcs.kv_put("mirrored-key", b"mirrored-value")
+            core.gcs.kv_put("mirrored-key-2", b"v2")
+            core._gcs_rpc.call("snapshot_now")  # writes local + mirrors
+            # A daemon holds the mirror.
+            assert _wait_for(
+                lambda: any(
+                    core._daemons.get(n.address).call("fetch_gcs_snapshot",
+                                                      timeout=10)
+                    for n in cluster.nodes),
+                timeout=30)
+            mirror_node = next(
+                n for n in cluster.nodes
+                if core._daemons.get(n.address).call("fetch_gcs_snapshot",
+                                                     timeout=10))
+
+            cluster.kill_gcs()
+            os.remove(snapshot)  # the head's DISK is gone
+            time.sleep(0.5)
+            cluster.restart_gcs(restore_from=mirror_node.address)
+        finally:
+            core.shutdown()
+            runtime_mod._global_runtime = None
+
+        core2 = connect(cluster.gcs_address)
+        try:
+            assert core2.gcs.kv_get("mirrored-key") == b"mirrored-value"
+            assert core2.gcs.kv_get("mirrored-key-2") == b"v2"
+        finally:
+            core2.shutdown()
+            runtime_mod._global_runtime = None
+    finally:
+        cluster.shutdown()
