@@ -291,3 +291,36 @@ def test_device_backend_mean_and_colocated_fallback():
         np.testing.assert_allclose(np.asarray(results[rank]),
                                    np.full((4,), 0.5))
     col.destroy_collective_group("dev-co")
+
+
+def test_device_backend_from_actors(ray_start_regular):
+    """backend="device" through REAL actors (in-process runtime: actors
+    share the process, each pins its array to a different virtual
+    device) — the eager §5.8 device-tier contract end-to-end."""
+    rt = ray_start_regular
+    from ray_tpu.parallel import collectives as col
+
+    world = 4
+
+    @rt.remote(max_concurrency=1)
+    class DeviceRank:
+        def __init__(self, rank):
+            self.rank = rank
+            self.dev = jax.devices()[rank]
+            col.init_collective_group(world, rank, backend="device",
+                                      group_name="adev")
+
+        def reduce(self):
+            x = jax.device_put(jnp.full((16,), float(self.rank + 1)),
+                               self.dev)
+            out = col.allreduce(x, op="sum", group_name="adev")
+            return (np.asarray(out),
+                    list(out.devices())[0] == self.dev)
+
+    ranks = [DeviceRank.remote(i) for i in range(world)]
+    results = rt.get([r.reduce.remote() for r in ranks], timeout=300)
+    expect = np.full((16,), float(sum(range(1, world + 1))))
+    for arr, on_own_device in results:
+        np.testing.assert_allclose(arr, expect)
+        assert on_own_device
+    col.destroy_collective_group("adev")

@@ -431,3 +431,41 @@ class TestModelBasedSearch:
         # best trial stops only via t >= max_t, which counts as budget end
         d = hb.on_trial_result(best, {"training_iteration": 9, "score": 8.0})
         assert d == TrialScheduler.STOP  # budget exhausted, not culled early
+
+
+class TestBOHBStyleComposition:
+    def test_tpe_searcher_with_hyperband_scheduler(self, ray_start_regular):
+        """BOHB's shape: a model-based searcher PROPOSES configs while
+        HyperBand's bracketed successive halving CULLS them early — the
+        two compose through the standard TuneConfig surface (reference:
+        tune/schedulers/hb_bohb.py + search/bohb)."""
+        from ray_tpu.tune.schedulers import HyperBandScheduler
+        from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
+
+        def trainable(cfg):
+            # Converges toward a config-dependent plateau; bad x plateaus
+            # low and should be culled at early rungs.
+            for i in range(1, 10):
+                score = (1.0 - (cfg["x"] - 0.6) ** 2) * (i / 9.0)
+                tune.report({"score": score})
+
+        space = {"x": tune.uniform(0.0, 1.0)}
+        res = Tuner(
+            trainable,
+            param_space=space,
+            tune_config=TuneConfig(
+                metric="score", mode="max", num_samples=12,
+                search_alg=ConcurrencyLimiter(
+                    TPESearcher(space, n_initial=4, seed=3),
+                    max_concurrent=3),
+                scheduler=HyperBandScheduler(
+                    metric="score", mode="max", max_t=9,
+                    reduction_factor=3),
+            ),
+        ).fit()
+        assert len(res) == 12
+        best = res.get_best_result()
+        assert best.metrics["score"] > 0.8, best.metrics
+        # HyperBand actually culled: some trials stopped before max_t.
+        iters = [r.metrics.get("training_iteration", 0) for r in res.results]
+        assert min(iters) < 9, iters
