@@ -250,11 +250,15 @@ def test_device_backend_allreduce_stays_on_device():
         except Exception as e:  # noqa: BLE001
             errors.append((rank, e))
 
-    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    threads = [threading.Thread(target=member, args=(r,), daemon=True)
+               for r in range(world)]
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=120)
+    # daemon=True: a wedged member must FAIL here, not hang interpreter
+    # exit at threading._shutdown.
+    assert not any(t.is_alive() for t in threads), "member thread hung"
     assert not errors, errors
     expect = sum(range(1, world + 1))  # 1+2+3+4
     for rank in range(world):
@@ -282,11 +286,13 @@ def test_device_backend_mean_and_colocated_fallback():
         x = jax.device_put(jnp.full((4,), float(rank)), dev)
         results[rank] = col.allreduce(x, op="mean", group_name="dev-co")
 
-    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    threads = [threading.Thread(target=member, args=(r,), daemon=True)
+               for r in range(world)]
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "member thread hung"
     for rank in range(world):
         np.testing.assert_allclose(np.asarray(results[rank]),
                                    np.full((4,), 0.5))

@@ -68,7 +68,8 @@ def test_per_node_proxies_and_drain_under_load(mp_serve):
         except Exception as e:  # noqa: BLE001 — refused post-drain
             results.append((i, f"refused:{type(e).__name__}"))
 
-    threads = [threading.Thread(target=fire, args=(i,)) for i in range(6)]
+    threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+               for i in range(6)]
     for t in threads:
         t.start()
     # Deterministic: drain only once the victim proxy has ACCEPTED at least
@@ -123,7 +124,7 @@ def test_rolling_redeploy_drops_zero_requests(mp_serve):
                 outcomes.append(f"error:{e}")
             time.sleep(0.02)
 
-    t = threading.Thread(target=hammer)
+    t = threading.Thread(target=hammer, daemon=True)
     t.start()
     try:
         time.sleep(0.5)
@@ -251,7 +252,8 @@ def test_grpc_per_node_proxies_and_drain_under_load(mp_serve):
         except grpc.RpcError as e:
             results.append((i, f"rpc:{e.code().name}"))
 
-    threads = [threading.Thread(target=fire, args=(i,)) for i in range(6)]
+    threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+               for i in range(6)]
     for t in threads:
         t.start()
     from ray_tpu.serve import api as serve_api
@@ -312,7 +314,7 @@ def test_grpc_rolling_redeploy_drops_zero_requests(mp_serve):
                 outcomes.append(f"rpc:{e.code().name}")
             time.sleep(0.02)
 
-    t = threading.Thread(target=hammer)
+    t = threading.Thread(target=hammer, daemon=True)
     t.start()
     took_over = False
     try:
