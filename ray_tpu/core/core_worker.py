@@ -37,7 +37,8 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import (RpcClient, RpcClientPool, RpcConnectionError,
                               RpcRemoteError)
-from ray_tpu.core.task_spec import TaskSpec, TaskType
+from ray_tpu.core.task_spec import (SpecCacheMiss, SpecEncoder, TaskSpec,
+                                    TaskType, spec_var_fields)
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("core_worker")
@@ -437,12 +438,18 @@ class _ActorCall:
     """One submitted actor call held until its reply is acked (the resend
     unit of the pipelined actor transport)."""
 
-    __slots__ = ("spec", "pending", "spec_bytes", "pinned", "nested_deps")
+    __slots__ = ("spec", "pending", "var_bytes", "digest", "template",
+                 "miss_retries", "pinned", "nested_deps")
 
     def __init__(self, spec: TaskSpec, pending: _PendingTask):
         self.spec = spec
         self.pending = pending
-        self.spec_bytes: Optional[bytes] = None  # serialized lazily, reused
+        # Cached-template wire encoding, produced lazily at send time (a
+        # resend clears var_bytes so window_min is recomputed).
+        self.var_bytes: Optional[bytes] = None
+        self.digest: Optional[bytes] = None
+        self.template: Optional[bytes] = None
+        self.miss_retries = 0  # SpecCacheMiss resends (bounded)
         self.pinned = True  # argument refs pinned until terminal
         self.nested_deps: Optional[list] = None  # refs inside arg values
 
@@ -463,14 +470,24 @@ class _LeasedWorker:
 
 
 class _QueuedTask:
-    __slots__ = ("spec", "spec_bytes", "pending", "attempt", "nested_deps",
-                 "finished")
+    __slots__ = ("spec", "spec_bytes", "digest", "template", "var_bytes",
+                 "pending", "attempt", "nested_deps", "finished")
 
     def __init__(self, spec: TaskSpec, pending: _PendingTask,
-                 refcounter: Optional["_LocalRefCounter"] = None):
+                 refcounter: Optional["_LocalRefCounter"] = None,
+                 encoder: Optional[SpecEncoder] = None):
         self.spec = spec
         with serialization.collecting_refs() as refs:
-            self.spec_bytes = serialization.dumps(spec)
+            if encoder is not None:
+                # Cached-template encoding: pickle only the per-call fields;
+                # the invariant template is memoized per callable and shipped
+                # to each worker connection once (see task_spec.SpecEncoder).
+                self.digest, self.template = encoder.encode_template(spec)
+                self.var_bytes = encoder.encode_vars(spec)
+                self.spec_bytes = None
+            else:
+                self.digest = self.template = self.var_bytes = None
+                self.spec_bytes = serialization.dumps(spec)
         # Refs nested inside arg VALUES (spec.dependencies() covers only
         # top-level ref args): pin them for the task's duration so the
         # callee's deferred borrow registration has cover (_finish_task
@@ -738,6 +755,9 @@ class CoreWorker:
         # Task submission machinery.
         self._submit_pool = ThreadPoolExecutor(max_workers=128,
                                                thread_name_prefix="submit")
+        # Cached task-spec encoding (the wire fast path): steady-state calls
+        # ship (digest, args) instead of a full pickled spec.
+        self._spec_encoder = SpecEncoder()
         self._actor_addr_cache: Dict[ActorID, str] = {}
         self._actor_queues: Dict[tuple, dict] = {}
         self._generators: Dict[TaskID, _GenState] = {}
@@ -1371,7 +1391,8 @@ class CoreWorker:
             self._submit_pool.submit(self._run_submission, spec, pending)
         else:
             self._dispatch(_QueuedTask(spec, pending,
-                                       refcounter=self.reference_counter))
+                                       refcounter=self.reference_counter,
+                                       encoder=self._spec_encoder))
 
     # ---------------- direct task transport ----------------
 
@@ -1614,8 +1635,9 @@ class CoreWorker:
             return True
         task.attempt += 1
         try:
-            result = self._worker_clients.get(entry.worker_addr).call(
-                "run_task", task.spec_bytes, entry.lease_id, timeout=None)
+            result = self._call_run_task(
+                self._worker_clients.get(entry.worker_addr), task,
+                entry.lease_id)
         except RpcConnectionError as e:
             # Worker process died mid-task: daemon's reaper releases the
             # lease; retry on a fresh lease or surface the death.
@@ -1657,6 +1679,29 @@ class CoreWorker:
             return False
         entry.lease_id = final_lease
         return True
+
+    def _call_run_task(self, client: RpcClient, task: _QueuedTask, lease_id):
+        """Push one task with the cached-template encoding: ship the spec
+        template once per (connection, callable), then (digest, args) per
+        call. A SpecCacheMiss (server evicted the template) re-sends it in
+        full exactly once."""
+        if task.spec_bytes is not None:  # legacy full-spec path
+            return client.call("run_task", task.spec_bytes, lease_id,
+                               timeout=None)
+        enc = self._spec_encoder
+        for retry in (False, True):
+            if client.template_cached(task.digest):
+                enc.wire_hits += 1
+            else:
+                client.send_template(task.digest, task.template)
+                enc.wire_misses += 1
+            try:
+                return client.call("run_task", (task.digest, task.var_bytes),
+                                   lease_id, timeout=None)
+            except SpecCacheMiss:
+                if retry:
+                    raise
+                client.forget_template(task.digest)
 
     def _redispatch_later(self, task: _QueuedTask, delay: float = None) -> None:
         if delay is None:
@@ -2012,14 +2057,17 @@ class CoreWorker:
                 self._begin_actor_recovery(key, st, addr)
                 return
             seq, call = heapq.heappop(st["heap"])
-            if call.spec_bytes is None:
+            if call.var_bytes is None:
                 # The admission baseline for a fresh incarnation: this
                 # handle's lowest outstanding seq right now (recovery clears
-                # spec_bytes so resends recompute it).
+                # var_bytes so resends recompute it).
                 call.spec.window_min = min(st["inflight"], default=seq)
                 try:
                     with serialization.collecting_refs() as _nested:
-                        call.spec_bytes = serialization.dumps(call.spec)
+                        call.digest, call.template = (
+                            self._spec_encoder.encode_template(call.spec))
+                        call.var_bytes = (
+                            self._spec_encoder.encode_vars(call.spec))
                     if call.nested_deps is None:  # once, not per resend
                         call.nested_deps = [r.id for r in _nested]
                         for noid in call.nested_deps:
@@ -2047,7 +2095,17 @@ class CoreWorker:
             client = self._actor_clients.get(addr)
             st["inflight"][seq] = (call, addr)
             try:
-                fut = client.call_async("run_actor_task", call.spec_bytes)
+                if client.template_cached(call.digest):
+                    self._spec_encoder.wire_hits += 1
+                else:
+                    client.send_template(call.digest, call.template)
+                    self._spec_encoder.wire_misses += 1
+                # Pipelined (other calls already in flight): hand the frame
+                # to the connection's sender thread so back-to-back submits
+                # coalesce into one sendmsg; sequential calls send inline.
+                fut = client.call_async("run_actor_task",
+                                        (call.digest, call.var_bytes),
+                                        _handoff=len(st["inflight"]) > 1)
             except (RpcConnectionError, OSError):
                 # call_async may have synchronously failed other in-flight
                 # futures (reentrant callbacks already moved them back).
@@ -2084,11 +2142,48 @@ class CoreWorker:
             with st["lock"]:
                 ent = st["inflight"].pop(seq, None)
                 if ent is not None:
-                    ent[0].spec_bytes = None  # resend: fresh window_min
+                    ent[0].var_bytes = None  # resend: fresh window_min
                     heapq.heappush(st["heap"], (seq, ent[0]))
                 self._begin_actor_recovery(key, st, addr)
             return
         except RpcRemoteError as e:
+            if isinstance(e.cause, SpecCacheMiss):
+                # The worker evicted our spec template before this call
+                # decoded (bounded cache churn): re-heap and re-pump — the
+                # forget() makes the next send ship the template in full.
+                # Bounded: an unexpected persistent miss must surface, not
+                # loop forever.
+                with st["lock"]:
+                    ent = st["inflight"].pop(seq, None)
+                    if ent is not None and ent[0].miss_retries < 3:
+                        call = ent[0]
+                        call.miss_retries += 1
+                        if call.digest is not None:
+                            try:
+                                self._actor_clients.get(addr) \
+                                    .forget_template(call.digest)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        heapq.heappush(st["heap"], (seq, call))
+                        ent = None
+                    self._pump_actor_queue(key, st)
+                if ent is not None:
+                    call = ent[0]
+                    self._finish_actor_call(call)
+                    self._record_task_error(
+                        call.spec, call.pending,
+                        TaskError.from_exception(
+                            f"{call.spec.function_name}."
+                            f"{call.spec.actor_method}", e.cause))
+                    # This seq will never execute: step admission over the
+                    # gap or every later call from the handle starves.
+                    try:
+                        self._actor_clients.get(addr).notify(
+                            "skip_actor_seq", call.spec.actor_id.binary(),
+                            call.spec.caller_id, seq)
+                    except (RpcConnectionError, OSError):
+                        pass
+                return
             with st["lock"]:
                 ent = st["inflight"].pop(seq, None)
             if ent is not None:
@@ -2151,7 +2246,7 @@ class CoreWorker:
         # the recovering-early-return path after re-heaping itself below.
         self._actor_clients.invalidate(addr)
         for seq, (call, _a) in sorted(st["inflight"].items()):
-            call.spec_bytes = None  # re-serialize with a fresh window_min
+            call.var_bytes = None  # re-serialize with a fresh window_min
             heapq.heappush(st["heap"], (seq, call))
         st["inflight"].clear()
         try:
@@ -2217,6 +2312,10 @@ class CoreWorker:
                 self.reference_counter.remove_submitted_task_reference(dep)
             for noid in (call.nested_deps or ()):
                 self.reference_counter.remove_submitted_task_reference(noid)
+
+    def spec_cache_stats(self) -> dict:
+        """Client-side cached-spec-encoding counters (benches read these)."""
+        return self._spec_encoder.stats()
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._actor_addr_cache.pop(actor_id, None)

@@ -65,8 +65,8 @@ def _memory_usage_fraction() -> Optional[float]:
 
 
 class _Worker:
-    __slots__ = ("worker_id", "proc", "address", "client", "actor_id", "busy",
-                 "env_key", "spawned_at")
+    __slots__ = ("worker_id", "proc", "address", "client", "actor_id",
+                 "actor_init", "busy", "env_key", "spawned_at")
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen,
                  env_key: Optional[str] = None):
@@ -75,6 +75,7 @@ class _Worker:
         self.address: Optional[str] = None
         self.client: Optional[RpcClient] = None
         self.actor_id: Optional[ActorID] = None  # dedicated to an actor
+        self.actor_init = False  # actor __init__ in flight (not a task)
         self.busy = False
         self.env_key = env_key  # runtime_env hash; None = vanilla pool
         # OOM policy: newest-spawned dies first. Monotonic — a wall-clock
@@ -262,6 +263,10 @@ class NodeDaemon:
     # Waiter patience: > the builder's full worst-case budget (venv 120s +
     # install 600s) so slow-but-succeeding builds don't fail their sharers.
     _PIP_WAIT_S = 900.0
+    # Conda builds run up to 1800s in ONE untouched subprocess step, so the
+    # staleness horizon and waiter patience both must exceed that.
+    _CONDA_BUILD_STALE_S = 2000.0
+    _CONDA_WAIT_S = 2100.0
 
     @staticmethod
     def _pip_env_root() -> str:
@@ -321,9 +326,17 @@ class NodeDaemon:
                     except OSError:
                         continue  # dir vanished: retry the claim
                 if age > self._PIP_BUILD_STALE_S:
+                    # Atomic takeover via rename (see the conda path): an
+                    # unconditional rmtree could act on an arbitrarily
+                    # stale `age` and delete a NEW builder's live claim.
+                    reap = f"{env_dir}.reap-{os.getpid()}-{time.time_ns()}"
+                    try:
+                        os.rename(env_dir, reap)
+                    except OSError:
+                        continue  # someone else reclaimed first
                     logger.warning("reclaiming stale pip env build %s "
                                    "(builder died?)", key)
-                    _shutil.rmtree(env_dir, ignore_errors=True)
+                    _shutil.rmtree(reap, ignore_errors=True)
                     continue
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -409,7 +422,11 @@ class NodeDaemon:
                                   timeout=60).stdout.strip()
             return python_of(os.path.join(base, "envs", conda_spec))
 
-        # dict: build a cached env from the yaml body.
+        # dict: build a cached env from the yaml body. Same claim protocol
+        # as the pip path: an atomic mkdir claims the prefix, a .building
+        # marker (with staleness reclaim) covers builder death, and waiters
+        # poll for .ready instead of building — two concurrent spawns can
+        # never rmtree each other's in-progress build.
         conda = _shutil.which("conda") or os.environ.get("CONDA_EXE")
         if not conda:
             raise RuntimeError(
@@ -418,25 +435,82 @@ class NodeDaemon:
         key = hashlib.sha1(json.dumps(conda_spec,
                                       sort_keys=True).encode()).hexdigest()[:16]
         prefix = os.path.join(self._pip_env_root(), f"conda-{key}")
-        if not os.path.exists(os.path.join(prefix, ".ready")):
-            import tempfile
-
-            import yaml  # type: ignore[import-untyped]
-
-            with tempfile.NamedTemporaryFile("w", suffix=".yml",
-                                             delete=False) as f:
-                yaml.safe_dump(conda_spec, f)
-                spec_path = f.name
-            out = subprocess.run(
-                [conda, "env", "create", "-p", prefix, "-f", spec_path],
-                capture_output=True, text=True, timeout=1800)
-            os.unlink(spec_path)
-            if out.returncode != 0:
+        ready = os.path.join(prefix, ".ready")
+        # The claim is a SIDECAR dir (conda insists on creating the prefix
+        # itself): atomic mkdir elects exactly one builder; the .building
+        # marker inside it covers builder death via staleness reclaim.
+        claim = prefix + ".claim"
+        building = os.path.join(claim, ".building")
+        deadline = time.time() + self._CONDA_WAIT_S
+        while True:
+            if os.path.exists(ready):
+                return python_of(prefix)
+            try:
+                os.makedirs(claim)
+            except FileExistsError:
+                # A builder holds the claim. Reclaim only if its .building
+                # marker is ancient (builder died without cleanup).
+                try:
+                    age = time.time() - os.stat(building).st_mtime
+                except OSError:
+                    try:
+                        age = time.time() - os.stat(claim).st_mtime
+                    except OSError:
+                        continue  # claim vanished: retry
+                if age > self._CONDA_BUILD_STALE_S:
+                    # Atomic takeover: rename the stale claim aside so only
+                    # ONE waiter reclaims (a second waiter's rename fails) —
+                    # an unconditional rmtree here could fire with an
+                    # arbitrarily stale `age` and delete a NEW builder's
+                    # live claim/prefix. Prefix debris is cleared by the
+                    # next claim OWNER, under the claim lock.
+                    reap = f"{claim}.reap-{os.getpid()}-{time.time_ns()}"
+                    try:
+                        os.rename(claim, reap)
+                    except OSError:
+                        continue  # someone else reclaimed first
+                    logger.warning("reclaiming stale conda env build %s "
+                                   "(builder died?)", key)
+                    _shutil.rmtree(reap, ignore_errors=True)
+                    continue
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"conda env {key} build by another process never "
+                        "finished")
+                time.sleep(0.5)
+                continue
+            try:
+                open(building, "w").close()
+                if os.path.exists(ready):
+                    # Lost the race benignly: the previous builder finished
+                    # between our ready-check and our claim.
+                    return python_of(prefix)
+                # Claim owner: any leftover prefix is a dead builder's
+                # debris (a LIVE builder always holds the claim).
                 _shutil.rmtree(prefix, ignore_errors=True)
-                raise RuntimeError(
-                    f"conda env create failed: {out.stderr[-1000:]}")
-            open(os.path.join(prefix, ".ready"), "w").close()
-        return python_of(prefix)
+                import tempfile
+
+                import yaml  # type: ignore[import-untyped]
+
+                with tempfile.NamedTemporaryFile("w", suffix=".yml",
+                                                 delete=False) as f:
+                    yaml.safe_dump(conda_spec, f)
+                    spec_path = f.name
+                out = subprocess.run(
+                    [conda, "env", "create", "-p", prefix, "-f", spec_path],
+                    capture_output=True, text=True, timeout=1800)
+                os.unlink(spec_path)
+                if out.returncode != 0:
+                    raise RuntimeError(
+                        f"conda env create failed: {out.stderr[-1000:]}")
+                open(ready, "w").close()
+                return python_of(prefix)
+            except BaseException:
+                # Only the claim OWNER ever deletes the prefix.
+                _shutil.rmtree(prefix, ignore_errors=True)
+                raise
+            finally:
+                _shutil.rmtree(claim, ignore_errors=True)
 
     # Env keys forwarded INTO worker containers (docker doesn't inherit the
     # daemon's environment the way a plain subprocess does).
@@ -877,6 +951,12 @@ class NodeDaemon:
         except BaseException as e:  # noqa: BLE001 — lease must not leak
             self._release(lease_id)
             raise WorkerDiedError(f"actor worker spawn failed: {e}") from e
+        # Mark the worker actor-bound BEFORE the (possibly seconds-long)
+        # __init__ RPC: a busy worker with actor_id unset reads as a
+        # retriable TASK worker to the memory monitor's OOM policy, which
+        # may SIGKILL it mid-init under pressure (actor creation is not
+        # retriable-by-lease the way tasks are).
+        worker.actor_init = True
         try:
             worker.client.call("start_actor", spec_bytes, timeout=None)
         except RpcConnectionError as e:
@@ -888,10 +968,12 @@ class NodeDaemon:
             raise WorkerDiedError(f"worker died during actor init: {e}") from e
         except Exception:
             self._release(lease_id)
+            worker.actor_init = False  # init failed: back to the task pool
             self._return_worker(worker)
             raise
         with self._pool_lock:
-            worker.actor_id = spec.actor_id
+            worker.actor_id = spec.actor_id  # set before actor_init drops
+            worker.actor_init = False
             self._actor_records[spec.actor_id] = (spec_bytes, worker.address)
         return worker.address
 
@@ -1242,6 +1324,7 @@ class NodeDaemon:
             with self._pool_lock:
                 busy_tasks = [w for w in self._workers.values()
                               if w.busy and w.actor_id is None
+                              and not w.actor_init
                               and w.proc.poll() is None]
                 if busy_tasks:
                     # Spawn timestamp, not pid: pids wrap around and pid

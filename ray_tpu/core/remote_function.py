@@ -113,6 +113,10 @@ class RemoteFunction:
             code_hash = uuid.uuid4().hex
         self._function_id = f"fn:{self._function_name}:{code_hash[:16]}"
         self._exported = False
+        # Override-free calls dominate the hot path: resolve options once
+        # (lazily — decoration must not raise) and reuse the SAME object so
+        # the cached task-spec template encoder memo-hits per callable.
+        self._plain_options = None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -135,7 +139,13 @@ class RemoteFunction:
         if not self._exported or rt.gcs.get_function(self._function_id) is None:
             rt.gcs.export_function(self._function_id, self._function)
             self._exported = True
-        options = resolve_options(self._default_options, overrides)
+        if overrides:
+            options = resolve_options(self._default_options, overrides)
+        else:
+            options = self._plain_options
+            if options is None:
+                options = self._plain_options = resolve_options(
+                    self._default_options, {})
         task_args, task_kwargs = make_task_args(args, kwargs)
         from ray_tpu.util import tracing
 

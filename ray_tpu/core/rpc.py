@@ -13,10 +13,26 @@ Wire format, one frame per message::
 
     8-byte big-endian length | payload = pickle((kind, request_id, method, data))
 
-``kind`` is ``"req"`` / ``"rep"`` / ``"err"`` / ``"note"`` (one-way).
+``kind`` is ``"req"`` / ``"rep"`` / ``"err"`` / ``"note"`` (one-way) /
+``"tmpl"`` (a task-spec template registration, processed IN ORDER on the
+connection loop — never handed to the pool — so a request referencing the
+template by digest can never race ahead of it).
 Requests multiplex over one connection: each carries a request id and replies
 may arrive out of order (the reference gets this from HTTP/2 streams; we get
 it from a reader thread matching ids to futures).
+
+Send path — the control-plane fast path: every connection owns a
+:class:`_FrameSender` that writes frames with ONE ``sendmsg`` scatter-gather
+syscall per batch (length prefix, header, and out-of-band payload buffers as
+separate iovecs — nothing is ever concatenated into an intermediate blob).
+Frames queued while a send is in flight coalesce into the next syscall, and
+an adaptive micro-window (``rpc_coalesce_window_us``, engaged only when the
+connection has recently seen back-to-back frames) lets non-urgent frames —
+server replies, one-way notes — wait a few dozen microseconds for company.
+Urgent frames (requests) and :meth:`RpcClient.flush` never wait on the
+window, so a blocking call is never delayed by the coalescer. The receive
+path mirrors it with a buffered reader: one ``recv`` refills up to 256 KiB
+and many small frames are parsed out of it without further syscalls.
 
 Bulk payloads ride OUT-OF-BAND (pickle protocol 5): any buffer ≥
 ``OOB_MIN_BYTES`` inside a message is stripped from the pickle stream and
@@ -48,7 +64,9 @@ import pickle
 import socket
 import struct
 import threading
+import time
 import traceback
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -171,13 +189,229 @@ def _dumps_frame(message: Tuple) -> Tuple[bytes, list, list]:
     return header, bufs, raws
 
 
-def _send_frame_oob(sock: socket.socket, header: bytes, bufs: list,
-                    lock: threading.Lock) -> None:
-    """One frame + its raw continuation, atomically w.r.t. other senders."""
-    with lock:
-        sock.sendall(_LEN.pack(len(header)) + header)
-        for b in bufs:
-            sock.sendall(b)
+# ---------------------------------------------------------------------------
+# Coalescing scatter-gather send path
+# ---------------------------------------------------------------------------
+
+# Per-process send-path counters (frames_per_syscall is the headline metric
+# tracked by benches/core_perf.py). Plain int stores under the GIL — stats,
+# not invariants.
+_SEND_STATS = {"frames": 0, "syscalls": 0, "bytes": 0, "batches": 0}
+
+# Keep each sendmsg comfortably under Linux's UIO_MAXIOV (1024).
+_IOV_MAX = 512
+
+
+def send_stats() -> dict:
+    """Snapshot of the process-wide frame-send counters."""
+    out = dict(_SEND_STATS)
+    out["frames_per_syscall"] = (
+        out["frames"] / out["syscalls"] if out["syscalls"] else 0.0)
+    return out
+
+
+def reset_send_stats() -> None:
+    for k in _SEND_STATS:
+        _SEND_STATS[k] = 0
+
+
+def _sendmsg_all(sock: socket.socket, iovecs: list) -> None:
+    """Write every buffer in ``iovecs`` with scatter-gather ``sendmsg``
+    syscalls — no intermediate concatenation, partial writes resumed."""
+    iovs = [b if isinstance(b, memoryview) else memoryview(b) for b in iovecs]
+    i, n = 0, len(iovs)
+    while i < n:
+        try:
+            sent = sock.sendmsg(iovs[i:i + _IOV_MAX])
+        except InterruptedError:
+            continue
+        _SEND_STATS["syscalls"] += 1
+        _SEND_STATS["bytes"] += sent
+        while sent:
+            b = iovs[i]
+            nb = b.nbytes
+            if sent >= nb:
+                sent -= nb
+                i += 1
+            else:
+                iovs[i] = b[sent:]
+                sent = 0
+        while i < n and iovs[i].nbytes == 0:
+            i += 1
+
+
+def _rpc_tunables() -> tuple:
+    """(window_s, max_batch_frames, max_batch_bytes) from the config table
+    (env-overridable as RAY_TPU_RPC_COALESCE_WINDOW_US etc.)."""
+    try:
+        from ray_tpu.core.config import config
+
+        cfg = config()
+        return (cfg.rpc_coalesce_window_us / 1e6,
+                cfg.rpc_max_batch_frames, cfg.rpc_max_batch_bytes)
+    except Exception:  # noqa: BLE001 — config unavailable mid-teardown;
+        # mirror the config DEFAULTS (window disabled) exactly.
+        return (0.0, 64, 1 << 20)
+
+
+class _FrameSender:
+    """Per-connection micro-batching sender.
+
+    Every ``send`` enqueues one frame (as a list of iovecs). If no drain is
+    in progress the calling thread drains the queue itself — an isolated
+    send therefore costs exactly one ``sendmsg`` with zero added latency.
+    Frames enqueued while another thread is mid-``sendmsg`` ride the
+    drainer's NEXT batch: one syscall for the lot. On top of that, a
+    non-urgent lone frame may wait ``window_s`` for company — but only when
+    the connection is "hot" (a recent drain actually coalesced), so
+    sequential request/reply traffic never pays the window. ``flush``
+    releases any window wait immediately.
+
+    ``raws`` release hooks fire exactly once after their frame's bytes are
+    written (or the send failed). A send failure poisons the sender: the
+    synchronous drainer re-raises, queued frames release their raws, and
+    ``on_error`` (if given) reports the failure to the connection owner —
+    the client uses it to fail all in-flight futures.
+    """
+
+    _HOT_S = 0.002  # how long one observed coalesce keeps the window armed
+
+    def __init__(self, sock: socket.socket, window_s: float | None = None,
+                 on_error: Optional[Callable[[BaseException], None]] = None):
+        win, max_frames, max_bytes = _rpc_tunables()
+        self._sock = sock
+        self._window = win if window_s is None else window_s
+        self._max_frames = max_frames
+        self._max_bytes = max_bytes
+        self._on_error = on_error
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: deque = deque()  # (iovecs, nbytes, raws, urgent)
+        self._draining = False
+        self._flush = False
+        self._hot_until = 0.0
+        self._helper: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def send(self, iovecs: list, raws=(), urgent: bool = True,
+             handoff: bool = False) -> None:
+        """``handoff=True``: enqueue and return immediately — a per-
+        connection helper thread drains. The caller races ahead producing
+        the next frame while the helper's ``sendmsg`` is in flight, so
+        single-threaded pipelined submitters (the actor window's submit
+        loop) coalesce instead of paying one syscall per frame."""
+        nbytes = sum(
+            b.nbytes if isinstance(b, memoryview) else len(b) for b in iovecs)
+        with self._cv:
+            if self._error is not None:
+                for r in raws:
+                    r.release_once()
+                raise self._error
+            self._queue.append((iovecs, nbytes, list(raws), urgent))
+            if self._draining:
+                # A drainer is mid-send: our frame rides its next batch.
+                self._cv.notify()
+                return
+            if handoff:
+                if self._helper is None or not self._helper.is_alive():
+                    self._helper = threading.Thread(
+                        target=self._helper_loop, name="rpc-sendq",
+                        daemon=True)
+                    self._helper.start()
+                self._cv.notify()
+                return
+            self._draining = True
+        self._drain()
+
+    def flush(self) -> None:
+        """Release any window wait and push queued frames out now."""
+        with self._cv:
+            if self._queue:
+                self._flush = True
+                self._cv.notify_all()
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            if self._error is None:
+                self._error = error or OSError("sender closed")
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()  # release the helper + window waiters
+        for _iv, _nb, raws, _u in leftovers:
+            for r in raws:
+                r.release_once()
+
+    def _helper_loop(self) -> None:
+        """Background drainer for handed-off frames; parks on the cv."""
+        while True:
+            with self._cv:
+                while self._error is None and (not self._queue
+                                               or self._draining):
+                    self._cv.wait(1.0)
+                if self._error is not None:
+                    return
+                self._draining = True
+            try:
+                self._drain()
+            except BaseException:  # noqa: BLE001 — poisoned via on_error
+                return
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                if not self._queue:
+                    self._draining = False
+                    return
+                if (self._window > 0.0 and not self._flush
+                        and len(self._queue) == 1
+                        and not self._queue[0][3]  # non-urgent lone frame
+                        and time.monotonic() < self._hot_until):
+                    self._cv.wait(self._window)
+                self._flush = False
+                iovecs: list = []
+                raws: list = []
+                nframes = nbytes = 0
+                while (self._queue and nframes < self._max_frames
+                       and (nframes == 0
+                            or nbytes + self._queue[0][1] <= self._max_bytes)):
+                    iv, nb, rw, _u = self._queue.popleft()
+                    iovecs += iv
+                    raws += rw
+                    nframes += 1
+                    nbytes += nb
+                if nframes > 1:
+                    self._hot_until = time.monotonic() + self._HOT_S
+            try:
+                _sendmsg_all(self._sock, iovecs)
+            except BaseException as e:  # noqa: BLE001 — poison + propagate
+                err = e if isinstance(e, OSError) else OSError(repr(e))
+                with self._cv:
+                    self._error = err
+                    leftovers = list(self._queue)
+                    self._queue.clear()
+                    self._draining = False
+                for r in raws:
+                    r.release_once()
+                for _iv, _nb, rw, _u in leftovers:
+                    for r in rw:
+                        r.release_once()
+                if self._on_error is not None:
+                    try:
+                        self._on_error(e)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("sender on_error hook failed")
+                raise
+            for r in raws:
+                r.release_once()
+            _SEND_STATS["frames"] += nframes
+            _SEND_STATS["batches"] += 1
+
+
+def _send_frame_oob(sender: "_FrameSender", header: bytes, bufs: list,
+                    raws=(), urgent: bool = True,
+                    handoff: bool = False) -> None:
+    """One frame + its raw continuation as a single scatter-gather send."""
+    sender.send([_LEN.pack(len(header)), header, *bufs], raws, urgent=urgent,
+                handoff=handoff)
 
 
 class BoundedSet:
@@ -218,47 +452,96 @@ class RpcRemoteError(RpcError):
         self.remote_traceback = remote_traceback
 
 
-def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
-    with lock:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+def _send_frame(sender: "_FrameSender", payload: bytes,
+                urgent: bool = True) -> None:
+    sender.send([_LEN.pack(len(payload)), payload], urgent=urgent)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    # recv_into a preallocated buffer: one copy, not chunk-list + join
-    # (which doubles memory traffic on multi-MB frames — the object plane's
-    # chunked pulls ride these).
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise RpcConnectionError("connection closed by peer")
-        got += r
-    return buf  # bytes-like; avoids a final copy on multi-MB frames
+class _SockReader:
+    """Buffered frame reader: one ``recv`` refills up to ``BUF`` bytes and
+    back-to-back small frames (the coalesced sends of the peer's
+    :class:`_FrameSender`) are parsed out of the buffer with no further
+    syscalls. Large reads — and zero-copy landings into a registered
+    destination — bypass the buffer and ``recv_into`` the target
+    directly, so bulk transfers keep their single-copy path."""
+
+    __slots__ = ("_sock", "_buf", "_pos")
+
+    # Below glibc's mmap threshold so the refill allocation recycles from
+    # the malloc arena instead of paying mmap/munmap per recv.
+    BUF = 64 * 1024
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = b""
+        self._pos = 0
+
+    def readexact(self, n: int):
+        avail = len(self._buf) - self._pos
+        if avail >= n:
+            out = memoryview(self._buf)[self._pos:self._pos + n]
+            self._pos += n
+            return out
+        out = bytearray(n)
+        view = memoryview(out)
+        got = 0
+        if avail:
+            view[:avail] = memoryview(self._buf)[self._pos:]
+            got = avail
+        self._buf, self._pos = b"", 0
+        while got < n:
+            want = n - got
+            if want >= self.BUF:
+                r = self._sock.recv_into(view[got:], want)
+                if r == 0:
+                    raise RpcConnectionError("connection closed by peer")
+                got += r
+                continue
+            chunk = self._sock.recv(self.BUF)
+            if not chunk:
+                raise RpcConnectionError("connection closed by peer")
+            take = min(len(chunk), want)
+            view[got:got + take] = memoryview(chunk)[:take]
+            got += take
+            if take < len(chunk):
+                self._buf, self._pos = chunk, take
+        return out
+
+    def readinto(self, dest: memoryview) -> None:
+        n = dest.nbytes
+        got = 0
+        avail = len(self._buf) - self._pos
+        if avail:
+            take = min(avail, n)
+            # numpy copy, not memoryview slice assignment: dest may be an
+            # exotic buffer (shm arena slot) where slice assignment
+            # degrades to ~75 MB/s (see serialization.fast_copy_into).
+            from ray_tpu.core.serialization import fast_copy_into
+
+            fast_copy_into(dest, 0,
+                           memoryview(self._buf)[self._pos:self._pos + take])
+            self._pos += take
+            got = take
+            if self._pos >= len(self._buf):
+                self._buf, self._pos = b"", 0
+        while got < n:
+            r = self._sock.recv_into(dest[got:], n - got)
+            if r == 0:
+                raise RpcConnectionError("connection closed by peer")
+            got += r
 
 
-def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
-    got = 0
-    n = view.nbytes
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise RpcConnectionError("connection closed by peer")
-        got += r
-
-
-def _recv_frame(sock: socket.socket, dest_resolver=None) -> Any:
+def _recv_frame(reader: _SockReader, dest_resolver=None) -> Any:
     """Read one message; transparently consumes "oob" raw continuations.
 
     ``dest_resolver(req_id, sizes)`` (client read loops only) may return a
     writable memoryview to receive a single-buffer continuation directly —
     the zero-copy landing path for chunked object pulls. Returns the
     message, with out-of-band buffers reconstructed as views."""
-    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    (length,) = _LEN.unpack(reader.readexact(_LEN.size))
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
-    msg = pickle.loads(_recv_exact(sock, length))
+    msg = pickle.loads(reader.readexact(length))
     if not (isinstance(msg, tuple) and msg and msg[0] == "oob"):
         return msg
     _, req_id, sizes, inner = msg
@@ -269,11 +552,11 @@ def _recv_frame(sock: socket.socket, dest_resolver=None) -> Any:
     if dest_resolver is not None and len(sizes) == 1:
         dest = dest_resolver(req_id, sizes[0])
     if dest is not None:
-        _recv_exact_into(sock, dest)
+        reader.readinto(dest)
         views = [dest]
     else:
         scratch = memoryview(bytearray(total))
-        _recv_exact_into(sock, scratch)
+        reader.readinto(scratch)
         views, off = [], 0
         for s in sizes:
             views.append(scratch[off:off + s])
@@ -350,7 +633,8 @@ class RpcServer:
             ).start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()
+        sender = _FrameSender(conn)
+        reader = _SockReader(conn)
         client_id = ""
         try:
             token = self._token
@@ -360,17 +644,29 @@ class RpcServer:
                 # closes the socket before pickle ever sees peer bytes.
                 import hmac
 
-                (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                (length,) = _LEN.unpack(reader.readexact(_LEN.size))
                 if length > 4096:
                     raise RpcConnectionError("oversized auth frame")
-                blob = _recv_exact(conn, length)
+                blob = bytes(reader.readexact(length))
                 if not hmac.compare_digest(blob, _AUTH_MAGIC + token):
                     logger.warning("%s: rejected connection with bad auth "
                                    "token", self._name)
                     raise RpcConnectionError("bad auth token")
             while not self._stopped.is_set():
-                kind, req_id, method, data = _recv_frame(conn)
-                if kind == "hello":
+                kind, req_id, method, data = _recv_frame(reader)
+                if kind == "tmpl":
+                    # Task-spec template registration: handled HERE, on the
+                    # connection loop, so it is ordered BEFORE any pooled
+                    # request that references it by digest.
+                    hook = getattr(self._handler, "register_spec_template",
+                                   None)
+                    if hook is not None:
+                        try:
+                            hook(*data)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("%s: register_spec_template "
+                                             "failed", self._name)
+                elif kind == "hello":
                     # Client identity frame (sent once right after connect):
                     # a stable id across this client's reconnects.
                     if not client_id and isinstance(data, str):
@@ -394,12 +690,13 @@ class RpcServer:
                     self._pool.submit(self._run_note, method, data)
                 elif kind == "req":
                     self._pool.submit(
-                        self._run_request, conn, send_lock, req_id, method,
+                        self._run_request, sender, req_id, method,
                         data, client_id,
                     )
         except (RpcConnectionError, OSError):
             pass
         finally:
+            sender.close()
             with self._conns_lock:
                 self._conns.discard(conn)
             try:
@@ -449,7 +746,7 @@ class RpcServer:
         except Exception:
             logger.exception("%s: notification %s failed", self._name, method)
 
-    def _run_request(self, conn, send_lock, req_id, method, data,
+    def _run_request(self, sender, req_id, method, data,
                      client_id: str = "") -> None:
         bufs: list = []
         raws: list = []
@@ -473,12 +770,12 @@ class RpcServer:
                      (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
                 )
         try:
-            _send_frame_oob(conn, frame, bufs, send_lock)
+            # Replies are coalescable (urgent=False): consecutive small
+            # task-finish reports ride ONE scatter-gather syscall to the
+            # owner when produced faster than the socket drains.
+            _send_frame_oob(sender, frame, bufs, raws, urgent=False)
         except OSError:
-            pass  # caller is gone; nothing to do
-        finally:
-            for r in raws:
-                r.release_once()
+            pass  # caller is gone; sender released the raws
 
     def stop(self) -> None:
         self._stopped.set()
@@ -520,7 +817,10 @@ class RpcClient:
         # (leases, leased workers) on this, not on TCP connections.
         self.client_id = uuid.uuid4().hex
         self._sock: Optional[socket.socket] = None
-        self._send_lock = threading.Lock()
+        self._sender: Optional[_FrameSender] = None
+        # Task-spec template digests this CONNECTION's server has been sent
+        # (reset with the socket: a fresh server process knows nothing).
+        self._sent_templates: set = set()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         # req_id → writable memoryview: replies for these ids land their
@@ -563,6 +863,8 @@ class RpcClient:
                 raise RpcConnectionError(
                     f"hello to {self.address} failed: {e}") from e
             self._sock = sock
+            self._sender = _FrameSender(sock, on_error=self._on_send_error)
+            self._sent_templates = set()
             threading.Thread(
                 target=self._read_loop, args=(sock,),
                 name=f"rpc-read-{self.address}", daemon=True,
@@ -582,10 +884,11 @@ class RpcClient:
             return dest
 
     def _read_loop(self, sock: socket.socket) -> None:
+        reader = _SockReader(sock)
         try:
             while True:
                 kind, req_id, _method, data = _recv_frame(
-                    sock, dest_resolver=self._resolve_dest)
+                    reader, dest_resolver=self._resolve_dest)
                 with self._state_lock:
                     fut = self._pending.pop(req_id, None)
                     dest_state = self._pending_dest.pop(req_id, None)
@@ -603,16 +906,26 @@ class RpcClient:
             # AttributeError unpickling a class the peer defined in __main__).
             self._fail_all(RpcConnectionError(f"connection to {self.address} lost: {e}"))
 
+    def _on_send_error(self, exc: BaseException) -> None:
+        """Drain-thread send failure: the enqueuing caller may already have
+        returned, so surface it by failing every in-flight future."""
+        self._fail_all(RpcConnectionError(
+            f"send to {self.address} failed: {exc}"))
+
     def _fail_all(self, error: Exception) -> None:
         with self._state_lock:
             pending, self._pending = self._pending, {}
             self._pending_dest.clear()
+            self._sent_templates = set()
+            sender, self._sender = self._sender, None
             if self._sock is not None:
                 try:
                     self._sock.close()
                 except OSError:
                     pass
                 self._sock = None
+        if sender is not None:
+            sender.close(error)
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(error)
@@ -620,12 +933,19 @@ class RpcClient:
     # -- calls ------------------------------------------------------------------
 
     def call_async(self, method: str, *args,
-                   _dest: Optional[memoryview] = None, **kwargs) -> Future:
+                   _dest: Optional[memoryview] = None,
+                   _handoff: bool = False, **kwargs) -> Future:
         """``_dest``: optional writable buffer; if the reply carries exactly
         one out-of-band payload of ``_dest.nbytes``, it is received straight
-        into it and ``fut.dest_written`` is True."""
-        sock = self._ensure_connected()
+        into it and ``fut.dest_written`` is True. ``_handoff``: queue the
+        frame for the connection's helper drainer instead of sending inline
+        — pipelined submitters coalesce their requests this way."""
+        self._ensure_connected()
         with self._state_lock:
+            sender = self._sender
+            if sender is None:
+                raise RpcConnectionError(
+                    f"connection to {self.address} lost")
             req_id = self._next_id
             self._next_id += 1
             fut: Future = Future()
@@ -635,16 +955,46 @@ class RpcClient:
                 self._pending_dest[req_id] = memoryview(_dest).cast("B")
         frame, bufs, raws = _dumps_frame(("req", req_id, method, (args, kwargs)))
         try:
-            _send_frame_oob(sock, frame, bufs, self._send_lock)
+            _send_frame_oob(sender, frame, bufs, raws, handoff=_handoff)
         except OSError as e:
             self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
-        finally:
-            for r in raws:
-                r.release_once()
         return fut
+
+    def flush(self) -> None:
+        """Push any coalescer-held frames out now (called before blocking
+        waits so a pending request never sits behind the window)."""
+        sender = self._sender
+        if sender is not None:
+            sender.flush()
+
+    # -- task-spec template cache (see task_spec.SpecEncoder) ----------------
+
+    def template_cached(self, digest: bytes) -> bool:
+        return digest in self._sent_templates
+
+    def forget_template(self, digest: bytes) -> None:
+        self._sent_templates.discard(digest)
+
+    def send_template(self, digest: bytes, blob: bytes) -> None:
+        """Ship a spec template to the peer; ordered BEFORE any subsequent
+        request on this connection (FIFO send queue + in-order conn loop)."""
+        self._ensure_connected()
+        with self._state_lock:
+            sender = self._sender
+        if sender is None:
+            raise RpcConnectionError(f"connection to {self.address} lost")
+        frame = _dumps(("tmpl", 0, "", (digest, blob)))
+        try:
+            _send_frame(sender, frame)
+        except OSError as e:
+            self._fail_all(RpcConnectionError(
+                f"send to {self.address} failed: {e}"))
+            raise RpcConnectionError(str(e)) from e
+        self._sent_templates.add(digest)
 
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
         fut = self.call_async(method, *args, **kwargs)
+        self.flush()
         try:
             return fut.result(timeout=timeout)
         except RpcRemoteError as e:
@@ -687,16 +1037,19 @@ class RpcClient:
                         "did not complete"))
 
     def notify(self, method: str, *args, **kwargs) -> None:
-        sock = self._ensure_connected()
+        self._ensure_connected()
+        with self._state_lock:
+            sender = self._sender
+        if sender is None:
+            raise RpcConnectionError(f"connection to {self.address} lost")
         frame, bufs, raws = _dumps_frame(("note", 0, method, (args, kwargs)))
         try:
-            _send_frame_oob(sock, frame, bufs, self._send_lock)
+            # One-way notes are coalescable: nobody blocks on them, so they
+            # may ride the adaptive window with other frames.
+            _send_frame_oob(sender, frame, bufs, raws, urgent=False)
         except OSError as e:
             self._fail_all(RpcConnectionError(f"send to {self.address} failed: {e}"))
             raise RpcConnectionError(str(e)) from e
-        finally:
-            for r in raws:
-                r.release_once()
 
     def close(self) -> None:
         with self._state_lock:

@@ -30,7 +30,8 @@ from ray_tpu.core.exceptions import ActorError, TaskCancelledError, TaskError
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcServer
-from ray_tpu.core.task_spec import DAG_LOOP_METHOD, TaskSpec
+from ray_tpu.core.task_spec import (DAG_LOOP_METHOD, SpecTemplateStore,
+                                    TaskSpec)
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("worker")
@@ -39,6 +40,16 @@ logger = get_logger("worker")
 class _DependencyFailed(Exception):
     def __init__(self, error):
         self.error = error
+
+
+def _lineage_bytes(spec: "TaskSpec") -> bytes:
+    """Re-pickle a decoded spec for the lineage record, inside a PRIVATE
+    ref-collection scope: the lazy materialization runs under
+    ``_package_results``'s ``collecting_refs`` block, and letting the
+    spec's ARGUMENT refs leak into that collector would register the
+    caller as borrower of refs the return value doesn't contain."""
+    with serialization.collecting_refs():
+        return serialization.dumps(spec)
 
 
 class _TaskEventBuffer:
@@ -89,6 +100,10 @@ class _ActorState:
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.next_seq: Dict[str, int] = {}  # caller_id -> next expected seq
+        # caller_id -> seq currently EXECUTING under strict serial ordering
+        # (cursor held for the call's whole runtime): admission waiters
+        # treat an executing predecessor as progress, not starvation.
+        self.executing: Dict[str, int] = {}
         # Seqs the client dropped before sending (unpicklable args): the
         # admission loop steps over them instead of waiting forever.
         self.skipped: Dict[str, set] = {}
@@ -130,6 +145,9 @@ class WorkerService:
         self._daemon = daemon_client
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
+        # Cached task-spec templates, registered in-order by the RPC conn
+        # loop ("tmpl" frames) before any request referencing them.
+        self._spec_store = SpecTemplateStore()
         self._task_lease = threading.local()
         self._events = _TaskEventBuffer(core._gcs_rpc)
         # Blocked-worker protocol (reference: CPU released while a worker
@@ -221,10 +239,19 @@ class WorkerService:
             "parent_span_id": parent,
         })
 
-    def run_task(self, spec_bytes: bytes, lease_id: str | None = None) -> dict:
+    def register_spec_template(self, digest: bytes, blob: bytes) -> None:
+        """Called by the RPC server's connection loop on "tmpl" frames."""
+        self._spec_store.register(digest, blob)
+
+    def run_task(self, spec_bytes, lease_id: str | None = None) -> dict:
         from ray_tpu.core.core_worker import arg_borrow_scope
 
-        spec: TaskSpec = serialization.loads(spec_bytes)
+        spec: TaskSpec = self._spec_store.decode(spec_bytes)
+        if not isinstance(spec_bytes, (bytes, bytearray, memoryview)):
+            # Cached-template call: the full spec pickle (lineage for
+            # reconstruction-by-resubmission) is only materialized if a
+            # sealed return actually records it.
+            spec_bytes = None
         self.core.current_task_id = spec.task_id
         st = {"lease_id": lease_id,
               "resources": spec.declared_resources(), "released": False}
@@ -239,7 +266,12 @@ class WorkerService:
                 args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
             args = kwargs = None  # drop frame pins before the borrow audit
-            out = self._package_results(spec, result, lineage=spec_bytes)
+            # Lineage = the full spec pickle. Cached-template calls carry
+            # no full pickle on the wire, so it is rebuilt lazily — only
+            # when a sealed return actually records it.
+            lineage = (spec_bytes if spec_bytes is not None
+                       else (lambda: _lineage_bytes(spec)))
+            out = self._package_results(spec, result, lineage=lineage)
             result = None
         except _DependencyFailed as df:
             out = self._package_error(spec, df.error)
@@ -327,7 +359,7 @@ class WorkerService:
         return args, kwargs
 
     def _package_results(self, spec: TaskSpec, result,
-                         lineage: bytes | None = None) -> dict:
+                         lineage=None) -> dict:
         # Lineage (the pickled creating TaskSpec) rides with every sealed
         # return of a NORMAL task so the cluster can reconstruct the object
         # by resubmission after node loss (object_recovery_manager.h:41).
@@ -375,6 +407,8 @@ class WorkerService:
         backpressures when it runs more than
         ``streaming_backpressure_items`` ahead of the consumer.
         """
+        if callable(lineage):
+            lineage = lineage()
         owner = None
         if spec.owner_addr:
             try:
@@ -424,7 +458,7 @@ class WorkerService:
         return {"ok": True, "returns": [], "generator_items": items}
 
     def _seal_return(self, oid: ObjectID, value,
-                     lineage: bytes | None = None,
+                     lineage=None,
                      force_seal: bool = False,
                      sealed_siblings: bool = False) -> Optional[bytes]:
         """Seal a return object so any process can fetch it; returns the
@@ -453,11 +487,15 @@ class WorkerService:
             # owner, and owner death is unrecoverable loss in the reference
             # too, so the hot path stays at zero control-plane RPCs.)
             if lineage is not None and sealed_siblings:
+                if callable(lineage):
+                    lineage = lineage()
                 try:
                     core._gcs_rpc.notify("add_lineage", oid.binary(), lineage)
                 except RpcConnectionError:
                     pass
             return ser.to_bytes()
+        if callable(lineage):
+            lineage = lineage()
         core.seal_serialized(oid, ser, lineage)
         return None
 
@@ -497,15 +535,41 @@ class WorkerService:
                     spec.actor_id.hex()[:8], spec.function_name, os.getpid())
         return True
 
-    def run_actor_task(self, spec_bytes: bytes) -> dict:
-        spec: TaskSpec = serialization.loads(spec_bytes)
+    def run_actor_task(self, spec_bytes) -> dict:
+        spec: TaskSpec = self._spec_store.decode(spec_bytes)
         with self._actors_lock:
             state = self._actors.get(spec.actor_id)
         if state is None:
             return self._package_error(
                 spec, ActorError(spec.actor_id.hex(),
                                  "actor not hosted by this worker"))
-        self._admit_in_order(state, spec)
+        # Serial actors (max_concurrency=1) promise per-caller EXECUTION
+        # order, not just admission order: the admission cursor advances
+        # only after this call completes (the ``finally`` below). Bumping
+        # before execution — the concurrent-actor behavior — lets an
+        # admitted-but-descheduled handler be overtaken at the actor lock
+        # by its successor; harmless when calls may interleave anyway,
+        # state corruption for a serial actor. Rarely observed while every
+        # request paid its own send syscall; the coalesced burst arrivals
+        # of the RPC fast path made it routine.
+        strict = state.serial
+        self._admit_in_order(state, spec, bump=not strict)
+        try:
+            return self._run_actor_task_admitted(state, spec)
+        finally:
+            if strict:
+                with state.cv:
+                    if state.executing.get(spec.caller_id) == \
+                            spec.sequence_number:
+                        del state.executing[spec.caller_id]
+                    cur = state.next_seq.get(spec.caller_id,
+                                             spec.sequence_number)
+                    state.next_seq[spec.caller_id] = max(
+                        cur, spec.sequence_number + 1)
+                    state.cv.notify_all()
+
+    def _run_actor_task_admitted(self, state: _ActorState,
+                                 spec: TaskSpec) -> dict:
         from ray_tpu.core.core_worker import arg_borrow_scope
 
         trace = self._begin_trace(spec)
@@ -586,7 +650,7 @@ class WorkerService:
             state.cv.notify_all()
 
     def _admit_in_order(self, state: _ActorState, spec: TaskSpec,
-                        timeout: float = 300.0) -> None:
+                        timeout: float = 300.0, bump: bool = True) -> None:
         """Per-caller sequence ordering (sequential_actor_submit_queue.cc):
         requests may arrive on pool threads out of order; admit strictly by
         the handle's sequence number.
@@ -629,12 +693,27 @@ class WorkerService:
                         f"actor task seq {spec.sequence_number} from "
                         f"{spec.caller_id[:8]} starved (expected "
                         f"{state.next_seq.get(spec.caller_id, 0)})")
+                before = state.next_seq[spec.caller_id]
                 state.cv.wait(timeout=min(remaining, 1.0))
-            # max(): a duplicate/straggler below next_seq must never rewind
-            # the admission cursor (that wedges every later call).
-            state.next_seq[spec.caller_id] = max(
-                state.next_seq[spec.caller_id], spec.sequence_number + 1)
-            state.cv.notify_all()
+                if (state.next_seq[spec.caller_id] > before
+                        or spec.caller_id in state.executing):
+                    # Progress: starvation means NO cursor movement AND no
+                    # predecessor executing, for `timeout` straight. Strict
+                    # serial execution holds the cursor for a call's whole
+                    # runtime — a legitimately long-running method (or a
+                    # deep-but-draining pipeline) must not read as a lost
+                    # sequence number.
+                    deadline = time.time() + timeout
+            if bump:
+                # max(): a duplicate/straggler below next_seq must never
+                # rewind the admission cursor (that wedges every later
+                # call). ``bump=False`` (strict serial execution): the
+                # caller advances the cursor itself AFTER the call runs.
+                state.next_seq[spec.caller_id] = max(
+                    state.next_seq[spec.caller_id], spec.sequence_number + 1)
+                state.cv.notify_all()
+            else:
+                state.executing[spec.caller_id] = spec.sequence_number
 
     # ====================== lifecycle ======================
 

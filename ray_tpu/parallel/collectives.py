@@ -409,6 +409,24 @@ class _DistributedGroup:
         self._peers.get(self._addrs[dst]).call(
             "deliver", tag, value, timeout=120.0)
 
+    @staticmethod
+    def _bc_subtree_consumers(rel: int, n: int) -> int:
+        """How many DESCENDANTS of relative rank ``rel`` in the binomial
+        broadcast tree will receive (and ack) a key published by ``rel``.
+        Node ``rel`` owns children ``rel + 2^k`` for ``2^k > rel`` while
+        ``rel + 2^k < n``; descendants ack recursively. Publishing with
+        ``n - 1`` on a non-root republisher (root's publish failed, chunk
+        arrived by socket) would leave ``shm_done`` forever short — only
+        the republisher's own subtree ever acks."""
+        count = 0
+        k = 1
+        while k < n:
+            if rel < k and rel + k < n:
+                child = rel + k
+                count += 1 + _DistributedGroup._bc_subtree_consumers(child, n)
+            k *= 2
+        return count
+
     def _ring_shm_consumers(self, first_dst: int, hops: int) -> int:
         """How many CONSECUTIVE downstream ring receivers (starting at
         ``first_dst``, following +1 for ``hops`` hops) share this rank's
@@ -660,7 +678,8 @@ class _DistributedGroup:
         if (children and key_holder is None and self._all_same_store
                 and self._shm is not None and isinstance(arr, np.ndarray)
                 and arr.nbytes >= self.SHM_MIN_BYTES):
-            key = self._publish_shm(arr, n - 1)
+            key = self._publish_shm(
+                arr, self._bc_subtree_consumers(rel, n))
             if key is not None:
                 # Root-side pseudo-holder: carries the key for forwarding;
                 # the root itself never acks/closes it.

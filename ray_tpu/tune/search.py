@@ -385,7 +385,10 @@ class TPESearcher(Searcher):
 
     def _categorical_axis(self, categories, good_vals, bad_vals):
         def probs(vals):
-            counts = {i: 1.0 for i in range(len(categories))}  # +1 smoothing
+            # Jeffreys (+0.5) smoothing: keeps every category drawable while
+            # leaving the density ratio informative on the SMALL good sets a
+            # γ-split produces (+1 washed the ratio out to ~flat).
+            counts = {i: 0.5 for i in range(len(categories))}
             for v in vals:
                 try:
                     counts[categories.index(v)] += 1.0
@@ -396,8 +399,15 @@ class TPESearcher(Searcher):
 
         pg, pb = probs(good_vals), probs(bad_vals)
         scores = [pg[i] / pb[i] for i in range(len(categories))]
-        # Sample ∝ l, then take the density-ratio argmax among candidates.
-        best_i = max(range(len(categories)), key=lambda i: scores[i])
+        # Sample candidates ∝ l (the smoothed good-set frequencies), then
+        # take the density-ratio argmax among THAT candidate set — the
+        # stochastic draw keeps exploration alive when suggestions are made
+        # back-to-back with no new observations (ConcurrencyLimiter with
+        # max_concurrent > 1); a deterministic argmax over all categories
+        # would emit the identical value every time.
+        k = max(1, min(self.n_candidates, len(categories)))
+        candidates = self.rng.choices(range(len(categories)), weights=pg, k=k)
+        best_i = max(candidates, key=lambda i: scores[i])
         return categories[best_i]
 
     def _model_suggest(self) -> Dict:

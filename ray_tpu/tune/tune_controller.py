@@ -18,10 +18,13 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.utils.logging import get_logger
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.session import TrainContext, TrainingResult, set_context
 from ray_tpu.tune.experiment import Trial, TrialStatus
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+
+logger = get_logger("tune")
 
 
 class _StopTrial(BaseException):
@@ -138,6 +141,22 @@ class TuneController:
         self.lazy_suggest = bool(searcher is not None
                                  and getattr(searcher, "sequential", False))
         self.num_samples = num_samples
+        if self.lazy_suggest and num_samples <= len(trials):
+            # A sequential searcher is only consulted for trials BEYOND the
+            # pre-generated ones, budgeted by num_samples (which defaults to
+            # 0): without this guard a direct TuneController user gets zero
+            # suggestions and — with no trials — an immediate clean exit
+            # that looks like success.
+            if not trials:
+                raise ValueError(
+                    "TuneController got a sequential searcher but "
+                    f"num_samples={num_samples} and no pre-generated trials: "
+                    "the searcher would never be consulted and the run would "
+                    "complete with zero trials. Pass num_samples > 0.")
+            logger.warning(
+                "TuneController: sequential searcher will never be consulted "
+                "(num_samples=%d <= %d pre-generated trials)",
+                num_samples, len(trials))
         self._suggested = len(trials)
         self._search_exhausted = False
         self._runners: Dict[str, Any] = {}

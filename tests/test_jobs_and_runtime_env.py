@@ -272,3 +272,113 @@ os.execvpe(cmd[0], cmd, env)
     finally:
         cluster.shutdown()
         os.environ.pop("RAY_TPU_CONTAINER_RUNTIME", None)
+
+
+class TestCondaEnvBuildRace:
+    """_ensure_conda_env's dict branch uses the pip path's claim protocol:
+    an atomic mkdir claim + a .building staleness marker, so two concurrent
+    spawns can never rmtree each other's in-progress build (ADVICE r5)."""
+
+    def _daemon(self, monkeypatch, tmp_path):
+        from ray_tpu.core.node_daemon import NodeDaemon
+
+        daemon = object.__new__(NodeDaemon)  # only env methods are used
+        monkeypatch.setattr(NodeDaemon, "_pip_env_root",
+                            staticmethod(lambda: str(tmp_path)))
+        return daemon
+
+    def _fake_conda(self, monkeypatch, build_log, build_delay=0.0):
+        import shutil
+        import subprocess
+
+        monkeypatch.setattr(shutil, "which",
+                            lambda name: "/usr/bin/conda"
+                            if name == "conda" else None)
+        real_run = subprocess.run
+
+        def fake_run(cmd, **kw):
+            if len(cmd) >= 3 and cmd[1:3] == ["env", "create"]:
+                prefix = cmd[cmd.index("-p") + 1]
+                build_log.append(prefix)
+                if build_delay:
+                    time.sleep(build_delay)
+                os.makedirs(os.path.join(prefix, "bin"), exist_ok=True)
+                with open(os.path.join(prefix, "bin", "python"), "w") as f:
+                    f.write("#!/bin/true\n")
+
+                class R:
+                    returncode = 0
+                    stderr = ""
+                return R()
+            return real_run(cmd, **kw)
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+
+    def test_concurrent_builders_single_build(self, monkeypatch, tmp_path):
+        """Two threads racing on the same spec: exactly one conda build
+        runs; the loser waits for .ready instead of deleting the winner's
+        in-progress env."""
+        import threading
+
+        daemon = self._daemon(monkeypatch, tmp_path)
+        build_log = []
+        self._fake_conda(monkeypatch, build_log, build_delay=0.6)
+        spec = {"dependencies": ["python=3.11"]}
+        results, errors = [], []
+
+        def build():
+            try:
+                results.append(daemon._ensure_conda_env(dict(spec)))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=build, daemon=True)
+                   for _ in range(2)]
+        threads[0].start()
+        time.sleep(0.15)  # let A claim and enter the slow build
+        threads[1].start()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert len(build_log) == 1, "both racers built (claim not honored)"
+        assert len(set(results)) == 1 and len(results) == 2
+        python = results[0]
+        assert os.path.exists(python), "winner's env was deleted by loser"
+        prefix = os.path.dirname(os.path.dirname(python))
+        assert os.path.exists(os.path.join(prefix, ".ready"))
+        assert not os.path.exists(prefix + ".claim"), "claim must be released"
+
+    def test_stale_claim_is_reclaimed(self, monkeypatch, tmp_path):
+        """A claim whose .building marker is ancient (builder died) is
+        reclaimed instead of wedging the spec forever."""
+        import hashlib
+        import json
+
+        daemon = self._daemon(monkeypatch, tmp_path)
+        build_log = []
+        self._fake_conda(monkeypatch, build_log)
+        spec = {"dependencies": ["python=3.11"]}
+        key = hashlib.sha1(json.dumps(
+            spec, sort_keys=True).encode()).hexdigest()[:16]
+        prefix = os.path.join(str(tmp_path), f"conda-{key}")
+        claim = prefix + ".claim"
+        os.makedirs(claim)
+        marker = os.path.join(claim, ".building")
+        open(marker, "w").close()
+        ancient = time.time() - 10_000
+        os.utime(marker, (ancient, ancient))
+        os.makedirs(prefix)  # dead builder's half-written debris
+        python = daemon._ensure_conda_env(spec)
+        assert os.path.exists(python)
+        assert len(build_log) == 1
+        assert os.path.exists(os.path.join(prefix, ".ready"))
+
+    def test_ready_env_reused_without_build(self, monkeypatch, tmp_path):
+        daemon = self._daemon(monkeypatch, tmp_path)
+        build_log = []
+        self._fake_conda(monkeypatch, build_log)
+        spec = {"dependencies": ["numpy"]}
+        p1 = daemon._ensure_conda_env(spec)
+        p2 = daemon._ensure_conda_env(spec)
+        assert p1 == p2
+        assert len(build_log) == 1

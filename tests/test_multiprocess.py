@@ -166,6 +166,29 @@ def test_actor_ordering_and_state(driver):
         list(range(1, 11))
 
 
+def test_serial_actor_strict_order_under_burst(driver):
+    """A serial actor EXECUTES per-caller calls strictly in sequence order
+    even when a deep pipelined burst lands coalesced (many requests in one
+    socket read, all racing the admission cv). Regression for the
+    admitted-but-overtaken race: next_seq used to advance before the
+    method ran, so an admitted handler could lose the actor lock to its
+    successor — ~10-call bursts rarely tripped it; coalesced 300-call
+    bursts did constantly."""
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    out = ray_tpu.get([c.incr.remote() for _ in range(300)], timeout=300)
+    assert out == list(range(1, 301)), f"out-of-order prefix: {out[:8]}"
+
+
 def test_named_actor_lookup(driver):
     @ray_tpu.remote
     class KV:

@@ -330,3 +330,84 @@ def test_device_backend_from_actors(ray_start_regular):
         np.testing.assert_allclose(arr, expect)
         assert on_own_device
     col.destroy_collective_group("adev")
+
+
+class TestBroadcastSubtreeAcks:
+    """_broadcast republisher ack accounting (ADVICE r5): a non-root rank
+    that publishes the payload to shm must expect acks from its binomial
+    SUBTREE only — publishing with consumers=n-1 would leave shm_done
+    forever short of zero and leak the backing object."""
+
+    def test_subtree_consumer_counts(self):
+        from ray_tpu.parallel.collectives import _DistributedGroup
+
+        f = _DistributedGroup._bc_subtree_consumers
+
+        def children(rel, n):
+            out, k = [], 1
+            while k < n:  # mirrors _broadcast's child enumeration
+                if rel < k and rel + k < n:
+                    out.append(rel + k)
+                k *= 2
+            return out
+
+        for n in range(1, 33):
+            # Root's subtree covers the whole tree: n-1 descendants.
+            assert f(0, n) == n - 1
+            for r in range(n):
+                # Recursive consistency: my acks = each child's delivery
+                # plus everything that child forwards.
+                assert f(r, n) == sum(1 + f(c, n) for c in children(r, n))
+        # Spot checks in the n=8 binomial tree: 1 -> {3, 5}, 3 -> {7}.
+        assert f(1, 8) == 3
+        assert f(2, 8) == 1  # 2 -> {6}
+        assert f(4, 8) == 0  # leaf
+
+    def test_republisher_publishes_with_subtree_count(self):
+        """Rank 1 of 4 (src=0) receives by socket (root's publish failed),
+        republishes to shm for its children: consumers must equal its
+        subtree size (1 = rank 3), not n-1 = 3."""
+        from ray_tpu.parallel.collectives import _DistributedGroup
+
+        g = object.__new__(_DistributedGroup)
+        g.world_size = 4
+        g.rank = 1
+        g._addrs = {i: f"addr{i}" for i in range(4)}
+        g._stores = {i: "storeA" for i in range(4)}
+        g._all_same_store = True
+        g._shm = object()  # only truthiness is checked on this path
+
+        published = {}
+
+        def publish(arr, consumers):
+            published["consumers"] = consumers
+            return b"k" * 16
+
+        g._publish_shm = publish
+
+        class _Fut:
+            def result(self, timeout=None):
+                return True
+
+        sent = []
+
+        class _Peer:
+            def call_async(self, method, *args):
+                sent.append((method, args))
+                return _Fut()
+
+        class _Peers:
+            def get(self, addr):
+                return _Peer()
+
+        g._peers = _Peers()
+        payload = np.ones(_DistributedGroup.SHM_MIN_BYTES // 8 + 16,
+                          dtype=np.float64)
+        g._service = None  # not used on this path
+        g._recv = lambda tag, timeout=120.0: payload  # socket delivery
+        out = g._broadcast(seq=9, value=None, src=0)
+        assert np.array_equal(out, payload)
+        assert published["consumers"] == \
+            _DistributedGroup._bc_subtree_consumers(1, 4) == 1
+        # The forward to the child went by shm key.
+        assert sent and sent[0][0] == "deliver_shm"

@@ -469,3 +469,98 @@ class TestBOHBStyleComposition:
         # HyperBand actually culled: some trials stopped before max_t.
         iters = [r.metrics.get("training_iteration", 0) for r in res.results]
         assert min(iters) < 9, iters
+
+
+class TestTPECategoricalExploration:
+    """_categorical_axis must SAMPLE candidates ∝ the smoothed good-set
+    frequencies and argmax the density ratio over that candidate set — the
+    old deterministic argmax over all categories emitted the identical
+    value on every back-to-back suggest (ADVICE r5), killing exploration
+    under ConcurrencyLimiter(max_concurrent>1)."""
+
+    def _searcher(self, seed=0):
+        from ray_tpu.tune.search import Choice, TPESearcher
+
+        space = {"c": Choice(["a", "b", "c"])}
+        # Small candidate pool so the draw visibly subsets the categories.
+        return TPESearcher(space, n_initial=2, n_candidates=4, seed=seed)
+
+    def test_back_to_back_draws_explore(self):
+        s = self._searcher(seed=3)
+        # good favors "a" heavily; "c" is rare-but-good (highest l/g ratio);
+        # bad concentrates on "a"/"b".
+        good = ["a"] * 8 + ["c"]
+        bad = ["a"] * 6 + ["b"] * 6
+        draws = [s._categorical_axis(["a", "b", "c"], good, bad)
+                 for _ in range(100)]
+        # No new observations between calls — the old code returned one
+        # category 100 times; the fix must explore.
+        assert len(set(draws)) > 1, "categorical axis collapsed to argmax"
+        # ...while still favoring categories that look good.
+        counts = {v: draws.count(v) for v in set(draws)}
+        assert counts.get("b", 0) < counts.get("a", 0) + counts.get("c", 0)
+
+    def test_candidates_follow_good_frequencies(self):
+        s = self._searcher(seed=11)
+        # Everything good is "b": the draw should essentially always pick it.
+        draws = [s._categorical_axis(["a", "b", "c"], ["b"] * 12, ["a"] * 6)
+                 for _ in range(50)]
+        assert draws.count("b") >= 45
+
+
+class TestTuneControllerLazySuggestGuard:
+    """TuneController must not silently complete with zero trials when a
+    sequential searcher is given but num_samples was left at 0 (ADVICE r5)."""
+
+    def _sequential_searcher(self):
+        from ray_tpu.tune.search import Searcher
+
+        class Seq(Searcher):
+            sequential = True
+
+            def suggest(self, trial_id):
+                return {"x": 1}
+
+        return Seq()
+
+    def test_zero_samples_no_trials_raises(self):
+        from ray_tpu.tune.tune_controller import TuneController
+
+        with pytest.raises(ValueError, match="num_samples"):
+            TuneController(lambda cfg: None, [],
+                           searcher=self._sequential_searcher())
+
+    def test_samples_below_pregenerated_warns(self, caplog):
+        import logging
+
+        from ray_tpu.tune.experiment import Trial
+        from ray_tpu.tune.tune_controller import TuneController
+
+        trials = [Trial(config={"x": 0}), Trial(config={"x": 1})]
+        # The ray_tpu root logger is propagate=False; caplog captures at the
+        # python root, so re-enable propagation for the assertion.
+        logging.getLogger("ray_tpu").propagate = True
+        try:
+            with caplog.at_level(logging.WARNING, logger="ray_tpu.tune"):
+                TuneController(lambda cfg: None, trials,
+                               searcher=self._sequential_searcher(),
+                               num_samples=2)
+        finally:
+            logging.getLogger("ray_tpu").propagate = False
+        assert any("never be consulted" in r.message for r in caplog.records)
+
+    def test_adequate_budget_is_silent(self, caplog):
+        import logging
+
+        from ray_tpu.tune.tune_controller import TuneController
+
+        logging.getLogger("ray_tpu").propagate = True
+        try:
+            with caplog.at_level(logging.WARNING, logger="ray_tpu.tune"):
+                TuneController(lambda cfg: None, [],
+                               searcher=self._sequential_searcher(),
+                               num_samples=4)
+        finally:
+            logging.getLogger("ray_tpu").propagate = False
+        assert not any("never be consulted" in r.message
+                       for r in caplog.records)
