@@ -134,6 +134,117 @@ def bench_objects(results: dict, big_mb: int, n_small: int) -> None:
     results["small_put_get_per_s"] = round(2 * n_small / dt, 1)
 
 
+def bench_object_plane(results: dict, core, cluster, quick: bool) -> None:
+    """Parallel object-plane read-path metrics (multiprocess runtime only):
+
+    - ``get_batch_per_s``: one ``get([64 refs])`` where every ref is owned
+      by another process (owner-served fetches) — the batched-get fan-out
+      vs the serial per-ref loop.
+    - ``multi_source_pull_gbps``: a 64 MB chunked pull with TWO replica
+      daemons available — the multi-source stripe vs a single source.
+    - ``seal_wakeup_latency_us``: time from a remote seal to get() return
+      on a waiting consumer — location-push wakeup vs the poll backoff.
+    """
+    import threading
+
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+
+    # -- batched multi-ref get -----------------------------------------------
+    @ray_tpu.remote
+    class Holder:
+        def make(self, n, size):
+            return [ray_tpu.put(os.urandom(size)) for _ in range(n)]
+
+        def seal_after(self, oid_bytes, delay, size):
+            from ray_tpu.core import serialization as _ser
+            from ray_tpu.core.ids import ObjectID as _OID
+            from ray_tpu.core.runtime import get_runtime
+
+            payload = _ser.serialize(b"x" * size).to_bytes()
+            time.sleep(delay)
+            # Timestamp BEFORE the seal: the push can wake the waiter
+            # before this method even returns from seal_payload (the
+            # daemon note is one-way), so an after-seal stamp underflows.
+            t_seal = time.monotonic()
+            get_runtime().seal_payload(_OID(oid_bytes), payload)
+            return t_seal
+
+    holder = Holder.remote()
+    n_refs = 64
+    refs = ray_tpu.get(holder.make.remote(n_refs, 4096), timeout=120)
+    reps = 10 if quick else 30
+
+    def batch_get():
+        # Values re-fetch from the owner each pass: drop the local cache.
+        with core._cache_lock:
+            for r in refs:
+                core._cache.pop(r.id, None)
+        ray_tpu.get(refs, timeout=120)
+
+    batch_get()  # warm connections
+    dt = timed(batch_get, repeat=reps)
+    results["get_batch_per_s"] = round(n_refs / dt, 1)
+    results["get_batch_latency_us"] = round(dt * 1e6, 1)
+
+    # -- multi-source chunked pull -------------------------------------------
+    mb = 64
+    blob = np.random.default_rng(1).random(mb * 1024 * 1024 // 8)
+    ref = ray_tpu.put(blob)
+    origin = core._gcs_rpc.call("locate_object", ref.id.binary())[0][0]
+    other = next(h for h in cluster.nodes if h.node_id != origin)
+
+    @ray_tpu.remote(scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+        node_id=other.node_id, soft=False))
+    def replicate(refs):
+        # Pull the object AND seal a replica on this node explicitly —
+        # heap-fallback pulls don't auto-register new locations (only
+        # shm-landing pulls do), and the bench needs a guaranteed second
+        # source either way.
+        from ray_tpu.core import serialization as _ser
+        from ray_tpu.core.runtime import get_runtime
+
+        value = ray_tpu.get(refs[0])
+        get_runtime().seal_serialized(refs[0].id, _ser.serialize(value))
+        return True
+
+    ray_tpu.get(replicate.remote([ref]), timeout=600)
+    deadline = time.time() + 60
+    while (len(core._gcs_rpc.call("locate_object", ref.id.binary())) < 2
+           and time.time() < deadline):
+        time.sleep(0.2)
+    n_srcs = len(core._gcs_rpc.call("locate_object", ref.id.binary()))
+
+    def pull():
+        with core._cache_lock:
+            core._cache.pop(ref.id, None)
+        ray_tpu.get(ref, timeout=600)
+
+    pull()
+    dt = timed(pull, repeat=2 if quick else 4)
+    results["multi_source_pull_gbps"] = round(blob.nbytes / dt / 1e9, 3)
+    results["multi_source_pull_sources"] = n_srcs
+    del ref, blob
+
+    # -- seal-to-wakeup latency ----------------------------------------------
+    stats_fn = getattr(core, "get_stats", None)
+    lat = []
+    sleeps0 = stats_fn()["backoff_sleeps"] if stats_fn else 0
+    for _ in range(5 if quick else 10):
+        oid = ObjectID.for_put()
+        seal_fut = holder.seal_after.remote(oid.binary(), 0.05, 256 * 1024)
+        ray_tpu.get(ObjectRef(oid), timeout=60)
+        t_ret = time.monotonic()
+        t_seal = ray_tpu.get(seal_fut, timeout=60)
+        lat.append(t_ret - t_seal)
+    lat.sort()
+    results["seal_wakeup_latency_us"] = round(lat[len(lat) // 2] * 1e6, 1)
+    if stats_fn:
+        s = stats_fn()
+        results["get_backoff_sleeps"] = s["backoff_sleeps"] - sleeps0
+        results["get_push_wakeups"] = s.get("push_wakeups", 0)
+
+
 def bench_broadcast(results: dict, mb: int, n_nodes: int) -> None:
     """1-to-N object broadcast across node daemons (the reference's 1 GiB
     broadcast envelope row, release/benchmarks/README.md:17-19)."""
@@ -261,6 +372,7 @@ def main() -> int:
             _settle(core, cluster)
             rpc_mod.reset_send_stats()  # measure the suite, not the boot
             r = run_suite("multiprocess", args.quick)
+            bench_object_plane(r, core, cluster, args.quick)
             # Control-plane fast-path health: how many frames each sendmsg
             # carried (driver-side) and how often steady-state calls skipped
             # the task-spec template (see README "Control-plane performance").

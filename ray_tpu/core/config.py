@@ -78,6 +78,23 @@ class Config:
     whole_frame_fetch_max = _Flag(1 * 1024 * 1024)
     pull_chunk_concurrency = _Flag(4)
     pull_memory_budget = _Flag(512 * 1024 * 1024)
+    # Batched get(): max refs fetched concurrently by one get([refs]) call
+    # (the bounded fan-out of the parallel read path; total in-flight pull
+    # bytes stay capped by pull_memory_budget regardless).
+    get_fanout = _Flag(8)
+    # Chunked pulls of objects at or above this size stripe their chunk
+    # ranges across ALL replica locations concurrently (multi-source pull);
+    # smaller objects pull from one replica — the per-source pipeline setup
+    # isn't worth it below a couple of chunks per source.
+    stripe_min_size = _Flag(16 * 1024 * 1024)
+    # Object-location push wakeups: waiters blocked in get() subscribe to
+    # the GCS object-location channel and wake on seal instead of sleeping
+    # through a poll backoff (the poll remains as a low-frequency fallback
+    # for GCS-restart recovery). Disable to restore pure polling.
+    location_sub_enabled = _Flag(True)
+    # Entries kept in the node store's deserialized-value cache (small
+    # values only; eviction is LRU).
+    deser_cache_entries = _Flag(256)
 
     # -- scheduling -----------------------------------------------------------
     # Hybrid policy threshold: below this utilization prefer packing on the

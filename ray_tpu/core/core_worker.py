@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import serialization
@@ -418,6 +419,39 @@ class _LocalRefCounter:
             self._core._free_object(object_id)
 
 
+class _Prefetch:
+    """One in-flight arg prefetch: resolvers piggyback on it only once a
+    pool thread has actually STARTED fetching; a merely-queued prefetch is
+    claimed (cancelled) by the resolver instead — waiting on work nobody
+    is doing would stall a perfectly fetchable object."""
+
+    __slots__ = ("event", "started")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.started = False
+
+
+class _LocWaiter:
+    """One blocked get()'s subscription to an object's seal: the GCS
+    location push sets the event and leaves the pushed replica location
+    behind, so the woken fetch skips the locate round trip entirely."""
+
+    __slots__ = ("event", "locations")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.locations: Optional[list] = None
+
+    def take_locations(self) -> Optional[list]:
+        # Re-arm BEFORE reading: a push landing mid-take then re-sets the
+        # event and its locations are picked up by this read or the next
+        # wakeup — clearing last would erase that push entirely.
+        self.event.clear()
+        locs, self.locations = self.locations, None
+        return locs
+
+
 class _PendingTask:
     __slots__ = ("refs", "done", "error", "cancelled")
 
@@ -616,6 +650,14 @@ class _OwnerService:
         with self._core._cache_lock:
             return self._core._inline_owned.get(ObjectID(oid_bytes))
 
+    def fetch_owned_batch(self, oid_bytes_list) -> list:
+        """Batched :meth:`fetch_owned`: one round trip serves every
+        inline-owned ref of a get([refs]) batch (None per miss) — N small
+        owner fetches collapse into one frame instead of N round trips."""
+        with self._core._cache_lock:
+            inline = self._core._inline_owned
+            return [inline.get(ObjectID(b)) for b in oid_bytes_list]
+
     def has_owned(self, oid_bytes: bytes) -> bool:
         with self._core._cache_lock:
             return ObjectID(oid_bytes) in self._core._inline_owned
@@ -791,6 +833,23 @@ class CoreWorker:
         self._ready_probe_sweep = 0.0  # next allowed eviction sweep
         self._borrow_sweeper_started = False
         self._pull = None  # lazy PullManager (chunked node-to-node fetches)
+
+        # Parallel object-plane read path: get() fan-out + location-push
+        # wakeups. _loc_waiters holds per-oid waiters blocked in _get_one;
+        # a lazily started subscriber long-polls the GCS object-location
+        # channel and wakes them on seal (locations ride the wakeup).
+        self._stats = {"locate_calls": 0, "push_wakeups": 0,
+                       "poll_timeouts": 0, "backoff_sleeps": 0}
+        self._loc_lock = threading.Lock()
+        self._loc_waiters: Dict[ObjectID, list] = {}
+        self._loc_sub_running = False
+        # In-flight arg prefetches: oid -> _Prefetch, finished (event set)
+        # when the fetch completes either way. A concurrent resolver WAITS
+        # on a STARTED prefetch instead of opening a second full fetch of
+        # the same bytes, and CLAIMS a merely-queued one.
+        self._prefetching: Dict[ObjectID, _Prefetch] = {}
+        self._prefetch_pool: Optional[ThreadPoolExecutor] = None
+        self._get_pool: Optional[ThreadPoolExecutor] = None
 
         # Execution context (worker mode fills these per task).
         self.current_task_id: Optional[TaskID] = None
@@ -1022,15 +1081,18 @@ class CoreWorker:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
         deadline = time.time() + timeout if timeout is not None else None
-        values = []
         try:
-            for r in ref_list:
-                value = self._get_one(r, deadline)
+            if len(ref_list) > 1:
+                # Batched fan-out: ONE locate round trip, concurrent
+                # fetches, caller-order results (see _get_batch).
+                values = self._get_batch(ref_list, deadline)
+            else:
+                values = [self._get_one(r, deadline) for r in ref_list]
+            for value in values:
                 if isinstance(value, TaskError):
                     raise value.as_instanceof_cause()
                 if isinstance(value, (TaskCancelledError, ActorError)):
                     raise value
-                values.append(value)
         finally:
             # Blocked-worker protocol: _get_one only ever RELEASES the
             # running task's lease; reacquire once per get() batch, not per
@@ -1039,83 +1101,538 @@ class CoreWorker:
                 self.unblocked_after_get()
         return values[0] if single else values
 
-    def _get_one(self, ref: ObjectRef, deadline: float | None):
+    def get_stats(self) -> dict:
+        """Read-path counters (benches/tests): locate RPCs issued by fetch
+        probes, push wakeups vs fallback-poll timeouts, and legacy backoff
+        sleeps (only taken with ``location_sub_enabled`` off)."""
+        return dict(self._stats)
+
+    def _get_batch(self, ref_list: List[ObjectRef], deadline: float | None,
+                   notify_blocked: bool = True) -> list:
+        """Resolve many refs concurrently through a bounded fan-out.
+
+        Dedupes ids, issues ONE ``locate_object_batch`` GCS round trip for
+        the unknown misses (vs one ``locate_object`` per ref), then fetches
+        every miss concurrently on up to ``get_fanout`` threads — total
+        in-flight pull bytes stay capped because all fetches share this
+        worker's :class:`PullManager` budget. Results come back in caller
+        order with serial first-error semantics preserved: refs are awaited
+        in order, and when one resolves to an error value the remaining
+        fetches are abandoned — the returned list is then SHORT, with the
+        error value last (the caller raises from it), exactly like the old
+        per-ref loop never reaching later refs.
+        """
+        order: List[ObjectRef] = []
+        seen: set = set()
+        for r in ref_list:
+            if r.id not in seen:
+                seen.add(r.id)
+                order.append(r)
+        with self._cache_lock:
+            values = {r.id: self._cache[r.id] for r in order
+                      if r.id in self._cache}
+            missing = [r for r in order if r.id not in values]
+            unknown = [r for r in missing if r.id not in self._pending]
+        if not missing:
+            return [values[r.id] for r in ref_list]
+        # One control-plane round trip locates every unknown miss; the
+        # results seed each fetch's first probe (locations hint).
+        located: Dict[ObjectID, list] = {}
+        if unknown:
+            try:
+                self._stats["locate_calls"] += 1
+                batches = self._gcs_rpc.call(
+                    "locate_object_batch",
+                    [r.id.binary() for r in unknown], timeout=30.0)
+                for r, locs in zip(unknown, batches):
+                    located[r.id] = locs
+            except (RpcConnectionError, TimeoutError):
+                pass  # per-ref fetches fall back to their own locate
+        # Owner-batch: misses with no daemon replica that share an owner
+        # collapse into ONE fetch_owned_batch round trip per owner process
+        # (inline objects live only in their owner's store — the dominant
+        # shape of a many-small-refs get).
+        owner_groups: Dict[str, List[ObjectRef]] = {}
+        for r in missing:
+            hint = getattr(r, "_owner_hint", None)
+            if (hint and hint != self.owner_address
+                    and not located.get(r.id)
+                    and not self._owner_unreachable(hint)):
+                owner_groups.setdefault(hint, []).append(r)
+        for hint, group in owner_groups.items():
+            if len(group) < 2:
+                continue
+            try:
+                payloads = self._owner_clients.get(hint).call(
+                    "fetch_owned_batch",
+                    [r.id.binary() for r in group], timeout=30.0)
+                self._note_owner_alive(hint)
+            except (RpcConnectionError, TimeoutError):
+                self._note_owner_unreachable(hint)
+                continue
+            except Exception:  # noqa: BLE001 — peer without the batch RPC
+                continue
+            loaded = [(r, serialization.loads(p))
+                      for r, p in zip(group, payloads) if p is not None]
+            with self._cache_cv:
+                for r, value in loaded:
+                    self._cache.setdefault(r.id, value)
+                    values[r.id] = self._cache[r.id]
+                if loaded:
+                    self._cache_cv.notify_all()
+        missing = [r for r in missing if r.id not in values]
+        if not missing:
+            return [values[r.id] for r in ref_list]
+        cancel = threading.Event()
+        # PER-CALL concurrency is bounded by the semaphore (the get_fanout
+        # knob); the threads come from a persistent shared pool, and each
+        # fetch runs in bounded ~1s SLICES that requeue themselves — a
+        # blocked fetch never holds a pool thread across its whole wait,
+        # so concurrent gets of ready objects can't starve behind it.
+        sem = threading.Semaphore(max(1, config().get_fanout))
+        pool = self._fanout_pool()
+        futs = {r.id: self._submit_sliced_fetch(
+                    pool, sem, r, deadline, located.get(r.id), cancel)
+                for r in missing}
+        out: list = []
+        error_found = False
+        try:
+            for r in ref_list:
+                if r.id not in values:
+                    values[r.id] = self._await_batch_future(
+                        futs[r.id], r, deadline, notify_blocked)
+                v = values[r.id]
+                out.append(v)
+                if isinstance(v, (TaskError, TaskCancelledError, ActorError)):
+                    # Serial first-error semantics: later refs are never
+                    # waited for once an earlier one resolved to an error.
+                    error_found = True
+                    return out
+            return out
+        except BaseException:
+            error_found = True
+            raise
+        finally:
+            if error_found:
+                cancel.set()
+
+    def _fanout_pool(self) -> ThreadPoolExecutor:
+        """Shared executor behind every batched get's fan-out. Fetches run
+        in bounded slices (see _submit_sliced_fetch), so pool threads are
+        never held across an unbounded wait; the size just sets how many
+        fetch slices run at once across all concurrent gets."""
+        pool = self._get_pool
+        if pool is None:
+            with self._cache_lock:
+                if self._get_pool is None:
+                    self._get_pool = ThreadPoolExecutor(
+                        max_workers=max(32, config().get_fanout * 8),
+                        thread_name_prefix="get-fanout")
+                pool = self._get_pool
+        return pool
+
+    _FETCH_SLICE_S = 1.0
+
+    def _submit_sliced_fetch(self, pool: ThreadPoolExecutor, sem, ref,
+                             deadline: float | None, locations, cancel
+                             ) -> Future:
+        """Run one ref's fetch as a chain of bounded pool slices.
+
+        Each slice runs _get_one with a ~1s sub-deadline; an unresolved
+        slice REQUEUES itself and returns its thread to the pool, so an
+        open-ended wait (deadline None is the norm) occupies a thread for
+        at most one slice at a time and unrelated gets interleave fairly.
+        The semaphore (per-call get_fanout bound) is held only within a
+        slice — waiting for it parks the thread at most 0.1s before the
+        slice requeues."""
+        out: Future = Future()
+        hint = [locations]  # consumed by the first slice's first probe
+
+        def run_slice():
+            if out.done():
+                return
+            if not sem.acquire(timeout=0.1):
+                requeue()
+                return
+            try:
+                if cancel.is_set():
+                    out.set_exception(GetTimeoutError(
+                        f"get() abandoned on {ref.id.hex()[:12]}"))
+                    return
+                now = time.time()
+                eff = (now + self._FETCH_SLICE_S if deadline is None
+                       else min(deadline, now + self._FETCH_SLICE_S))
+                loc, hint[0] = hint[0], None
+                try:
+                    value = self._get_one(ref, eff, False, loc, cancel)
+                except GetTimeoutError:
+                    if ((deadline is None or time.time() < deadline)
+                            and not cancel.is_set()):
+                        requeue()  # slice expired, not the caller's deadline
+                        return
+                    out.set_exception(GetTimeoutError(
+                        f"get() timed out on {ref.id.hex()[:12]}"))
+                except BaseException as exc:  # noqa: BLE001
+                    out.set_exception(exc)
+                else:
+                    out.set_result(value)
+            finally:
+                sem.release()
+
+        def requeue():
+            try:
+                pool.submit(run_slice)
+            except RuntimeError:  # pool shut down (process exit)
+                out.set_exception(GetTimeoutError(
+                    f"get() abandoned on {ref.id.hex()[:12]}"))
+
+        requeue()
+        return out
+
+    def _await_batch_future(self, fut: Future, ref: ObjectRef,
+                            deadline: float | None, notify_blocked: bool):
+        """Wait for one fan-out fetch on the coordinating thread, engaging
+        the blocked-worker hook like the serial path (the fetch threads
+        never touch it — the lease belongs to THIS thread's task)."""
+        started = time.time()
+        slice_s = 0.05  # first slice short so the hook fires at ~50ms
+        while True:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                try:
+                    # The fetch may have completed just as the deadline
+                    # hit — a value that's already here must be returned,
+                    # exactly as the serial path's cache check would.
+                    return fut.result(timeout=0)
+                except FuturesTimeout:
+                    raise GetTimeoutError(
+                        f"get() timed out on {ref.id.hex()[:12]}") from None
+            try:
+                return fut.result(timeout=min(slice_s, remaining)
+                                  if remaining is not None else slice_s)
+            except FuturesTimeout:
+                if fut.done():
+                    # Done now: either the value landed in the race window
+                    # after the wait expired (return it) or the fetch
+                    # itself raised (result re-raises the REAL exception —
+                    # on 3.11+ futures.TimeoutError aliases TimeoutError,
+                    # which a fetch's own GetTimeoutError subclasses, so
+                    # a bare re-raise would conflate the two).
+                    return fut.result(timeout=0)
+
+            if (notify_blocked and self.blocked_on_get is not None
+                    and time.time() - started > 0.05):
+                notify_blocked = False
+                self.blocked_on_get()
+            slice_s = 0.5
+
+    def resolve_refs(self, refs: List[ObjectRef],
+                     deadline: float | None = None,
+                     notify_blocked: bool = True) -> list:
+        """Raw-value resolution for task-argument fetch: like get() but
+        errors come back AS VALUES (the caller wraps them in its own
+        dependency-failure protocol). Same short-list-on-error contract as
+        :meth:`_get_batch`."""
+        if len(refs) == 1:
+            return [self._get_one(refs[0], deadline,
+                                  notify_blocked=notify_blocked)]
+        return self._get_batch(refs, deadline, notify_blocked=notify_blocked)
+
+    def prefetch_refs(self, refs: List[ObjectRef]) -> None:
+        """Fire-and-forget concurrent resolution into the local cache —
+        task-arg prefetch: dependency fetch overlaps queueing/admission
+        instead of starting when the task finally runs. Bounded by a shared
+        ``get_fanout``-wide pool; duplicate prefetches of an oid coalesce."""
+        todo = []
+        with self._cache_lock:
+            for r in refs:
+                if r.id in self._cache or r.id in self._prefetching:
+                    continue
+                self._prefetching[r.id] = _Prefetch()
+                todo.append(r)
+            if todo and self._prefetch_pool is None:
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=max(1, config().get_fanout),
+                    thread_name_prefix="prefetch")
+            pool = self._prefetch_pool
+        for i, r in enumerate(todo):
+            try:
+                pool.submit(self._prefetch_one, r)
+            except RuntimeError:  # pool shut down (process exit)
+                # Finish EVERY not-yet-submitted registration, not just
+                # this one — a leaked never-set Event would park later
+                # resolvers on the piggyback wait forever.
+                for rr in todo[i:]:
+                    self._finish_prefetch(rr.id)
+                return
+
+    def _prefetch_one(self, ref: ObjectRef) -> None:
+        with self._cache_lock:
+            ent = self._prefetching.get(ref.id)
+            if ent is None:
+                return  # claimed by a resolver while we sat in the queue
+            ent.started = True
+        try:
+            self._get_one(ref, time.time() + 300.0, notify_blocked=False,
+                          is_prefetch=True)
+        except BaseException:  # noqa: BLE001 — advisory; the real arg
+            pass               # fetch surfaces any error
+        finally:
+            self._finish_prefetch(ref.id)
+
+    def _finish_prefetch(self, oid: ObjectID) -> None:
+        with self._cache_lock:
+            ent = self._prefetching.pop(oid, None)
+        if ent is not None:
+            ent.event.set()  # release resolvers piggybacking on this fetch
+
+    def _get_one(self, ref: ObjectRef, deadline: float | None,
+                 notify_blocked: bool = True, locations: list | None = None,
+                 cancel_event: threading.Event | None = None,
+                 is_prefetch: bool = False):
         """Resolve one ref; while BLOCKED in a worker, the task's lease is
         released so nested tasks can't deadlock a fully leased cluster
         (the reference's blocked-worker CPU release), and reacquired on the
-        same node before returning."""
+        same node before returning.
+
+        ``locations`` seeds the FIRST fetch probe (the batched get's single
+        locate round trip), consumed once. ``cancel_event`` is the
+        abandoned-batch signal — exit promptly once the coordinating get()
+        has already raised. While waiting for a seal, a registered
+        location waiter wakes on the GCS object-location push (the pushed
+        location rides the wakeup, so the retry skips locate entirely);
+        the timed wait doubles as the low-frequency poll fallback that
+        survives a GCS restart."""
         oid = ref.id
         backoff = 0.001
         missing_since: float | None = None
         recovered = False
         started = time.time()
-        notified_blocked = False
-        while True:
-            if (not notified_blocked
-                    and self.blocked_on_get is not None
-                    and time.time() - started > 0.05):
-                notified_blocked = True
-                self.blocked_on_get()
-            with self._cache_lock:
-                if oid in self._cache:
-                    return self._cache[oid]
-                pending = self._pending.get(oid)
-            if pending is not None:
-                remaining = None if deadline is None else deadline - time.time()
-                if remaining is not None and remaining <= 0:
-                    raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-                # Bounded slices so the loop re-checks the blocked-worker
-                # hook (a full-deadline wait would never release the lease).
-                pending.done.wait(timeout=min(remaining, 1.0)
-                                  if remaining is not None else 1.0)
+        last_locate = 0.0
+        notified_blocked = not notify_blocked
+        owner_hint = getattr(ref, "_owner_hint", None)
+        waiter = None
+        sub_enabled = config().location_sub_enabled
+        # Owner-served (inline) objects never publish a location row, so
+        # their seal can only be seen by the owner probe — keep that poll
+        # at the legacy cadence. Everything else can relax to a slow
+        # fallback poll because the push wakes it.
+        poll_cap = 0.1 if (owner_hint and owner_hint != self.owner_address
+                           ) or not sub_enabled else 0.5
+        try:
+            while True:
+                if cancel_event is not None and cancel_event.is_set():
+                    raise GetTimeoutError(
+                        f"get() abandoned on {oid.hex()[:12]}")
+                if (not notified_blocked
+                        and self.blocked_on_get is not None
+                        and time.time() - started > 0.05):
+                    notified_blocked = True
+                    self.blocked_on_get()
                 with self._cache_lock:
                     if oid in self._cache:
                         return self._cache[oid]
-                if pending.done.is_set():
-                    # Completed but not cached here (e.g. ref from another
-                    # process path) — fall through to the fetch path.
-                    pass
-            value = self._try_fetch(oid, getattr(ref, "_owner_hint", None))
-            if value is not _MISSING:
-                with self._cache_cv:
-                    self._cache[oid] = value
-                    self._cache_cv.notify_all()
-                return value
-            # Lineage-based recovery (object_recovery_manager.h:41): the
-            # object has no live replica — if the GCS kept its creating
-            # TaskSpec, resubmit it once; the re-executed task re-seals the
-            # same return ids. Brief grace first (a fresh task's seal may
-            # not have landed), then probe the lineage table at most once
-            # per second so waiting consumers don't hot-loop the GCS.
-            now = time.time()
-            missing_since = missing_since or now
-            if (not recovered and pending is None
-                    and now - missing_since > 0.5
-                    and now - getattr(self, "_last_lineage_probe", 0.0) > 1.0):
-                self._last_lineage_probe = now
-                if self._maybe_recover(oid):
-                    recovered = True
-                    missing_since = None
+                    pending = self._pending.get(oid)
+                    inflight = None
+                    if not is_prefetch:
+                        ent = self._prefetching.get(oid)
+                        if ent is not None:
+                            if ent.started:
+                                inflight = ent.event
+                            else:
+                                # Queued but not running: claim it — THIS
+                                # thread becomes the fetch (the queued
+                                # prefetch no-ops when it finds its entry
+                                # gone).
+                                self._prefetching.pop(oid, None)
+                                ent.event.set()
+                if inflight is not None and pending is None:
+                    # A prefetch already owns this fetch: piggyback on it
+                    # instead of pulling the same bytes twice. Bounded
+                    # slices keep the blocked-hook/deadline checks live; a
+                    # FAILED prefetch sets the event without caching, and
+                    # the next iteration fetches normally.
+                    remaining = (None if deadline is None
+                                 else deadline - time.time())
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out on {oid.hex()[:12]}")
+                    inflight.wait(min(remaining, 0.5)
+                                  if remaining is not None else 0.5)
                     continue
-            owner_hint = getattr(ref, "_owner_hint", None)
-            if (pending is None and owner_hint
-                    and owner_hint != self.owner_address
-                    and self._owner_presumed_dead(owner_hint)):
-                # Object's only possible replica was its owner's in-process
-                # cache (no locations, no lineage — both were just probed)
-                # and the owner has been unreachable past the death window:
-                # fail like the reference's OwnerDiedError instead of
-                # spinning forever.
-                from ray_tpu.core.exceptions import ObjectLostError
+                if pending is not None:
+                    remaining = (None if deadline is None
+                                 else deadline - time.time())
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out on {oid.hex()[:12]}")
+                    # Bounded slices so the loop re-checks the blocked-worker
+                    # hook (a full-deadline wait would never release the
+                    # lease).
+                    pending.done.wait(timeout=min(remaining, 1.0)
+                                      if remaining is not None else 1.0)
+                    with self._cache_lock:
+                        if oid in self._cache:
+                            return self._cache[oid]
+                    if pending.done.is_set():
+                        # Completed but not cached here (e.g. ref from
+                        # another process path) — fall through to the fetch
+                        # path.
+                        pass
+                # With a waiter armed, the push announces new locations —
+                # the locate RPC drops to a ~4 Hz fallback (GCS-restart
+                # recovery) instead of firing on every poll iteration; the
+                # owner probe inside _try_fetch keeps its full cadence
+                # (inline objects never publish a location row).
+                now0 = time.time()
+                allow_locate = (waiter is None or locations is not None
+                                or now0 - last_locate >= 0.25)
+                if allow_locate and locations is None:
+                    last_locate = now0
+                value = self._try_fetch(oid, owner_hint, locations=locations,
+                                        skip_locate=not allow_locate)
+                locations = None
+                if value is not _MISSING:
+                    with self._cache_cv:
+                        self._cache[oid] = value
+                        self._cache_cv.notify_all()
+                    return value
+                # Lineage-based recovery (object_recovery_manager.h:41): the
+                # object has no live replica — if the GCS kept its creating
+                # TaskSpec, resubmit it once; the re-executed task re-seals
+                # the same return ids. Brief grace first (a fresh task's seal
+                # may not have landed), then probe the lineage table at most
+                # once per second so waiting consumers don't hot-loop the
+                # GCS.
+                now = time.time()
+                missing_since = missing_since or now
+                if (not recovered and pending is None
+                        and now - missing_since > 0.5
+                        and now - getattr(self, "_last_lineage_probe", 0.0)
+                        > 1.0):
+                    self._last_lineage_probe = now
+                    if self._maybe_recover(oid):
+                        recovered = True
+                        missing_since = None
+                        continue
+                if (pending is None and owner_hint
+                        and owner_hint != self.owner_address
+                        and self._owner_presumed_dead(owner_hint)):
+                    # Object's only possible replica was its owner's
+                    # in-process cache (no locations, no lineage — both were
+                    # just probed) and the owner has been unreachable past
+                    # the death window: fail like the reference's
+                    # OwnerDiedError instead of spinning forever.
+                    from ray_tpu.core.exceptions import ObjectLostError
 
-                raise ObjectLostError(
-                    oid.hex()[:12],
-                    f"owner process ({owner_hint}) died and no other "
-                    "replica or lineage exists")
-            if deadline is not None and time.time() >= deadline:
-                raise GetTimeoutError(f"get() timed out on {oid.hex()[:12]}")
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 0.1)
+                    raise ObjectLostError(
+                        oid.hex()[:12],
+                        f"owner process ({owner_hint}) died and no other "
+                        "replica or lineage exists")
+                if deadline is not None and time.time() >= deadline:
+                    raise GetTimeoutError(
+                        f"get() timed out on {oid.hex()[:12]}")
+                if pending is not None and not pending.done.is_set():
+                    continue  # pending.done.wait already paced this round
+                # (A set-but-unfetchable pending falls through to the
+                # waiter/backoff pacing below — otherwise this loop would
+                # spin at RPC speed against a value that never lands.)
+                if sub_enabled:
+                    if waiter is None:
+                        # Register BEFORE the next probe so a seal landing
+                        # between probe and wait can never be missed
+                        # (last_locate resets so that re-probe REALLY asks
+                        # the GCS once more post-registration).
+                        waiter = self._register_loc_waiter(oid)
+                        last_locate = 0.0
+                        continue
+                    remaining = (None if deadline is None
+                                 else deadline - time.time())
+                    wait_s = (backoff if remaining is None
+                              else max(0.0, min(backoff, remaining)))
+                    if waiter.event.wait(wait_s):
+                        self._stats["push_wakeups"] += 1
+                        locations = waiter.take_locations()
+                        backoff = 0.001  # fresh signal: retry eagerly
+                    else:
+                        self._stats["poll_timeouts"] += 1
+                        backoff = min(backoff * 2, poll_cap)
+                else:
+                    self._stats["backoff_sleeps"] += 1
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, poll_cap)
+        finally:
+            if waiter is not None:
+                self._unregister_loc_waiter(oid, waiter)
+
+    # -- object-location push wakeups (subscribe_object_locations) ----------
+
+    def _register_loc_waiter(self, oid: ObjectID) -> "_LocWaiter":
+        waiter = _LocWaiter()
+        with self._loc_lock:
+            self._loc_waiters.setdefault(oid, []).append(waiter)
+            start = not self._loc_sub_running
+            if start:
+                self._loc_sub_running = True
+        if start:
+            threading.Thread(target=self._loc_subscriber_loop,
+                             name="loc-sub", daemon=True).start()
+        return waiter
+
+    def _unregister_loc_waiter(self, oid: ObjectID, waiter) -> None:
+        with self._loc_lock:
+            waiters = self._loc_waiters.get(oid)
+            if waiters is not None:
+                try:
+                    waiters.remove(waiter)
+                except ValueError:
+                    pass
+                if not waiters:
+                    self._loc_waiters.pop(oid, None)
+
+    def _loc_subscriber_loop(self) -> None:
+        """Long-poll the GCS object-location channel and wake registered
+        waiters on seal. Started lazily with the first waiter; exits after
+        a few idle seconds (an idle worker holds no GCS poll slot). On GCS
+        loss the cursor resets to 'now' — the waiters' fallback poll covers
+        anything sealed during the outage."""
+        cursor = None
+        idle_since: float | None = None
+        while not self._shutdown:
+            with self._loc_lock:
+                has_waiters = bool(self._loc_waiters)
+            if not has_waiters:
+                now = time.time()
+                if idle_since is None:
+                    idle_since = now
+                elif now - idle_since > 5.0:
+                    with self._loc_lock:
+                        if not self._loc_waiters:
+                            self._loc_sub_running = False
+                            return
+                    idle_since = None
+                time.sleep(0.05)
+                continue
+            idle_since = None
+            try:
+                cursor, messages = self._gcs_rpc.call(
+                    "subscribe_object_locations", cursor, 5.0, timeout=35.0)
+            except (RpcConnectionError, TimeoutError):
+                cursor = None  # GCS restarted: resync from 'now'
+                time.sleep(0.5)
+                continue
+            except Exception:  # noqa: BLE001 — e.g. mid-shutdown teardown
+                time.sleep(0.5)
+                continue
+            if not messages:
+                continue
+            with self._loc_lock:
+                for oid_bytes, node_id, addr, size in messages:
+                    waiters = self._loc_waiters.get(ObjectID(oid_bytes))
+                    if waiters and addr:
+                        for w in waiters:
+                            w.locations = [(node_id, addr, size)]
+                            w.event.set()
 
     def _maybe_recover(self, oid: ObjectID) -> bool:
         """Resubmit the task that created ``oid`` (lineage reconstruction)."""
@@ -1142,8 +1659,17 @@ class CoreWorker:
         self._submit(spec, pending)
         return True
 
-    def _try_fetch(self, oid: ObjectID, owner_hint: str | None = None):
-        """Local shm → owner's in-process store → located daemons."""
+    def _try_fetch(self, oid: ObjectID, owner_hint: str | None = None,
+                   locations: list | None = None,
+                   skip_locate: bool = False):
+        """Local shm → owner's in-process store → located daemons.
+
+        ``locations`` short-circuits the GCS locate round trip when the
+        caller already knows the replica set (batched get's single
+        ``locate_object_batch``, or a location-push wakeup).
+        ``skip_locate``: probe only the local/owner planes — a subscribed
+        waiter gets its location discovery from the push, so the locate
+        RPC runs at fallback cadence only."""
         key_bytes = oid.binary()
         if self._shm is not None:
             from ray_tpu.core.node_daemon import NodeDaemon
@@ -1167,42 +1693,54 @@ class CoreWorker:
                     return serialization.loads(payload)
             except (RpcConnectionError, TimeoutError):
                 self._note_owner_unreachable(owner_hint)
-        try:
-            locations = self._gcs_rpc.call("locate_object", key_bytes)
-        except RpcConnectionError:
-            return _MISSING
+        if locations is None:
+            if skip_locate:
+                return _MISSING
+            try:
+                self._stats["locate_calls"] += 1
+                locations = self._gcs_rpc.call("locate_object", key_bytes)
+            except RpcConnectionError:
+                return _MISSING
         # Prefer a same-node replica (zero extra hop); spread remote pulls
         # across replicas so broadcasts fan out instead of serializing on
         # the origin daemon.
         import random
 
         locations = list(locations)
+        if not locations:
+            return _MISSING
         random.shuffle(locations)
         locations.sort(key=lambda loc: loc[0] != self.current_node_id)
-        for node_id, addr, _size in locations:
-            try:
-                value = self._fetch_from_daemon(oid, addr)
-            except (RpcConnectionError, TimeoutError):
-                continue
-            if value is not _MISSING:
-                return value
-        return _MISSING
+        return self._fetch_remote(oid, locations)
 
-    def _fetch_from_daemon(self, oid: ObjectID, addr: str):
-        """Fetch one replica: whole-frame for small objects, chunked pull
-        (pipelined bounded frames, budgeted) for big ones — landing the
-        replica in the LOCAL shm arena when possible so this node becomes a
+    def _fetch_remote(self, oid: ObjectID, locations: list):
+        """Fetch a daemon replica: whole-frame handshake against the
+        preferred source for small objects; big ones open a chunked pull
+        STRIPED across every replica daemon at once (multi-source pull),
+        landing in the LOCAL shm arena when possible so this node becomes a
         new location (broadcast fan-out, push_manager.cc's role)."""
         from ray_tpu.core.node_daemon import NodeDaemon
 
         key_bytes = oid.binary()
-        # One round trip for the common case: small payloads come back
-        # directly; bigger ones use the chunked pull that lands straight
-        # in the LOCAL arena and registers a new replica — broadcast
-        # fan-out instead of serializing every fetch on the origin daemon.
-        reply = self._daemons.get(addr).call(
-            "fetch_or_meta", key_bytes, config().whole_frame_fetch_max,
-            timeout=60.0)
+        addrs = list(dict.fromkeys(addr for _n, addr, _s in locations))
+        reply = None
+        preferred = None
+        dead: set = set()
+        for i, addr in enumerate(addrs):
+            try:
+                # One round trip for the common case: small payloads come
+                # back directly; bigger ones answer with their size so the
+                # chunked pull can be budgeted and striped.
+                reply = self._daemons.get(addr).call(
+                    "fetch_or_meta", key_bytes,
+                    config().whole_frame_fetch_max, timeout=60.0)
+            except (RpcConnectionError, TimeoutError):
+                dead.add(addr)
+                continue
+            if reply is not None:
+                preferred = i
+                break
+            dead.add(addr)  # reachable but replica gone: not a source
         if reply is None:
             return _MISSING
         if "payload" in reply:
@@ -1212,6 +1750,11 @@ class CoreWorker:
 
         if self._pull is None:
             self._pull = PullManager(self._daemons)
+        # The preferred (same-node / first-reachable) source leads; every
+        # other replica that didn't just fail the probe joins the stripe
+        # when the object is big enough.
+        srcs = [addrs[preferred]] + [a for j, a in enumerate(addrs)
+                                     if j != preferred and a not in dead]
         key = NodeDaemon._shm_key(key_bytes)
         dest_view = None
         if self._shm is not None:
@@ -1220,7 +1763,8 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 — arena full / contended
                 dest_view = None
         if dest_view is not None:
-            if not self._pull.pull_into(addr, key_bytes, size, dest_view):
+            if not self._pull.pull_into_multi(srcs, key_bytes, size,
+                                              dest_view):
                 self._shm.abort(key)
                 return _MISSING
             self._shm.seal(key)
@@ -1237,7 +1781,7 @@ class CoreWorker:
             finally:
                 self._shm.release(key)
         buf = bytearray(size)
-        if not self._pull.pull_into(addr, key_bytes, size, buf):
+        if not self._pull.pull_into_multi(srcs, key_bytes, size, buf):
             return _MISSING
         return serialization.loads(buf)
 
@@ -2505,6 +3049,10 @@ class CoreWorker:
             except RpcConnectionError:
                 pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        if self._prefetch_pool is not None:
+            self._prefetch_pool.shutdown(wait=False, cancel_futures=True)
+        if self._get_pool is not None:
+            self._get_pool.shutdown(wait=False, cancel_futures=True)
         self._owner_server.stop()
         self._owner_clients.close_all()
         self._daemons.close_all()

@@ -733,6 +733,13 @@ class GcsService:
     def _task_key(object_id: bytes) -> bytes:
         return object_id[:24]  # ObjectID = TaskID(24) + return index (4)
 
+    # Channel name for object-location push notifications (rides the same
+    # long-poll pubsub as node/actor/log events). Every seal publishes
+    # (oid, node_id, node_addr, size) so waiters blocked in get() wake on
+    # seal instead of polling locate_object (the reference's
+    # ownership-based directory sends the same location-update pushes).
+    _OBJ_LOC_CHANNEL = "object_locations"
+
     def add_object_location(self, object_id: bytes, node_id: NodeID,
                             size: int, lineage: bytes | None = None) -> None:
         with self._lock:
@@ -745,6 +752,9 @@ class GcsService:
                 if len(self._lineage) >= self._lineage_cap:
                     self._lineage.pop(next(iter(self._lineage)))
                 self._lineage[tk] = lineage
+            addr = self._node_addr.get(node_id)
+        self._publish(self._OBJ_LOC_CHANNEL,
+                      (object_id, node_id, addr, size))
 
     def add_lineage(self, object_id: bytes, lineage: bytes) -> None:
         """Register a task's lineage WITHOUT a location row — inline-small
@@ -774,6 +784,37 @@ class GcsService:
                 if addr is not None:
                     out.append((node_id, addr, size))
             return out
+
+    def locate_object_batch(
+            self, object_ids: List[bytes]
+    ) -> List[List[Tuple[NodeID, str, int]]]:
+        """Batched :meth:`locate_object`: one RPC resolves every ref of a
+        get([refs]) call instead of one round trip per miss."""
+        with self._lock:
+            out = []
+            for object_id in object_ids:
+                locs = []
+                for node_id, size in self._objects.get(object_id, {}).items():
+                    addr = self._node_addr.get(node_id)
+                    if addr is not None:
+                        locs.append((node_id, addr, size))
+                out.append(locs)
+            return out
+
+    def subscribe_object_locations(self, cursor: Optional[int],
+                                   timeout: float = 30.0):
+        """Long-poll the object-location channel from ``cursor``; returns
+        ``(next_cursor, [(oid, node_id, addr, size), ...])``.
+
+        ``cursor=None`` tails from NOW: returns the current end cursor with
+        no messages (subscribers use it to start, and to resync after a GCS
+        restart without replaying the retained log)."""
+        with self._pub_cv:
+            log = self._pub_log.get(self._OBJ_LOC_CHANNEL, [])
+            end = self._pub_base.get(self._OBJ_LOC_CHANNEL, 0) + len(log)
+        if cursor is None:
+            return end, []
+        return self.poll_channel(self._OBJ_LOC_CHANNEL, cursor, timeout)
 
     def get_lineage(self, object_id: bytes) -> Optional[bytes]:
         with self._lock:

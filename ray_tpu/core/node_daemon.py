@@ -135,6 +135,13 @@ class NodeDaemon:
                                        self.node_id.hex()[:12])
         self._spilled: Dict[bytes, int] = {}  # key -> size
         self._pending_spills: Dict[bytes, float] = {}  # uncommitted uploads
+        # Positional-read fd cache for spill-served chunks: striped pulls
+        # issue many concurrent chunk reads per object, and an open+seek
+        # per chunk would pay path resolution each time. os.pread is
+        # thread-safe (no shared file offset), so one fd serves all of an
+        # object's concurrent chunk requests.
+        self._spill_fds: Dict[bytes, int] = {}
+        self._spill_fd_lock = threading.Lock()
 
         # --- worker pool ----------------------------------------------------
         self._pool_lock = threading.Lock()
@@ -1087,30 +1094,77 @@ class NodeDaemon:
     def fetch_object_chunk(self, object_id: bytes, offset: int, length: int):
         """One chunk of a replica (``object_manager.cc:812`` chunked
         transfer): bounded frames instead of one object-sized frame.
-        Served as an out-of-band :class:`Raw` view straight out of the shm
-        arena — the socket write is the only copy this process makes, and
-        the shm refcount is held until the frame is on the wire."""
+        EVERY residency serves the chunk as an out-of-band :class:`Raw`
+        buffer — shm views straight out of the arena (refcount held until
+        the frame is on the wire), heap blobs as zero-copy memoryviews, and
+        spill files via cached-fd ``pread`` — so the socket write is the
+        only copy this process makes and the puller's registered
+        destination receives the bytes directly (no in-band pickle copy on
+        either side)."""
+        from ray_tpu.core.rpc import Raw
+
         if self._shm is not None:
             key = self._shm_key(object_id)
             view = self._shm.get(key)
             if view is not None:
-                from ray_tpu.core.rpc import Raw
-
                 return Raw(view[offset:offset + length],
                            release=lambda k=key: self._shm.release(k))
         with self._heap_lock:
             blob = self._heap.get(object_id)
             if blob is not None:
-                return blob[offset:offset + length]
+                # The Raw view pins the blob until the frame is written —
+                # a racing free_object can pop the dict entry safely.
+                return Raw(memoryview(blob)[offset:offset + length])
             spilled = object_id in self._spilled
         if spilled:
-            try:
-                with open(self._spill_path(object_id), "rb") as f:
-                    f.seek(offset)
-                    return f.read(length)
-            except OSError:
-                return None
+            chunk = self._spill_pread(object_id, offset, length)
+            if chunk is not None:
+                return Raw(chunk)
         return None
+
+    _SPILL_FD_CAP = 32
+
+    def _spill_pread(self, object_id: bytes, offset: int,
+                     length: int) -> Optional[bytes]:
+        """Positional read from a spilled object via the bounded fd cache."""
+        # The read happens under the lock so an eviction/free can never
+        # close an fd another thread is mid-pread on. pread of a
+        # page-cached chunk is a memcpy with the GIL released; spill is the
+        # cold tier, so serializing its reads per daemon is an acceptable
+        # price for a race-free cache.
+        with self._spill_fd_lock:
+            fd = self._spill_fds.get(object_id)
+            if fd is None:
+                try:
+                    fd = os.open(self._spill_path(object_id), os.O_RDONLY)
+                except OSError:
+                    return None
+                self._spill_fds[object_id] = fd
+                while len(self._spill_fds) > self._SPILL_FD_CAP:
+                    _oid, old = next(iter(self._spill_fds.items()))
+                    del self._spill_fds[_oid]
+                    try:
+                        os.close(old)
+                    except OSError:
+                        pass
+            try:
+                return os.pread(fd, length, offset)
+            except OSError:
+                self._spill_fds.pop(object_id, None)
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                return None
+
+    def _drop_spill_fd(self, object_id: bytes) -> None:
+        with self._spill_fd_lock:
+            fd = self._spill_fds.pop(object_id, None)
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     def begin_spill_put(self, object_id: bytes, size: int) -> bool:
         """Open a chunked UPLOAD straight to the spill shelf — how clients
@@ -1118,6 +1172,7 @@ class NodeDaemon:
         holding it whole in memory (create_request_queue.cc's fallback
         allocation, done chunk-wise over the wire)."""
         os.makedirs(self._spill_dir, exist_ok=True)
+        self._drop_spill_fd(object_id)  # stale fd from a prior incarnation
         with open(self._spill_path(object_id), "wb") as f:
             f.truncate(size)
         with self._heap_lock:
@@ -1143,6 +1198,7 @@ class NodeDaemon:
         clients that died mid-push)."""
         with self._heap_lock:
             self._pending_spills.pop(object_id, None)
+        self._drop_spill_fd(object_id)
         try:
             os.remove(self._spill_path(object_id))
         except OSError:
@@ -1201,6 +1257,7 @@ class NodeDaemon:
             self._heap.pop(object_id, None)
             spilled = self._spilled.pop(object_id, None)
         if spilled is not None:
+            self._drop_spill_fd(object_id)
             try:
                 os.remove(self._spill_path(object_id))
             except OSError:

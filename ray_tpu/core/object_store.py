@@ -28,7 +28,8 @@ logger = get_logger("object_store")
 
 class StoredObject:
     __slots__ = (
-        "serialized", "size", "create_time", "spilled_path", "pinned", "shm_keys",
+        "serialized", "size", "create_time", "last_access", "spilled_path",
+        "pinned", "shm_keys",
     )
 
     def __init__(self, serialized: Optional[SerializedObject], size: int | None = None):
@@ -38,6 +39,8 @@ class StoredObject:
         else:
             self.size = serialized.total_size() if serialized is not None else 0
         self.create_time = time.monotonic()
+        # Bumped on every read: the LRU clock for spill eviction.
+        self.last_access = self.create_time
         self.spilled_path = None
         self.pinned = 0
         # buffer index -> shm key for buffers held in the native arena
@@ -55,7 +58,10 @@ class MemoryStore:
         self._capacity = capacity_bytes or config().object_store_memory
         self._used = 0
         self._spill_dir = config().object_spilling_dir
+        # Small LRU of deserialized values (≤1MB each); insertion order is
+        # recency order — hits re-insert, inserts past the cap evict oldest.
         self._deser_cache: Dict[ObjectID, object] = {}
+        self._deser_cache_cap = max(1, config().deser_cache_entries)
         # Native shm arena (the plasma plane) for large buffers; optional.
         self._native = None
         self._native_threshold = config().native_store_threshold
@@ -156,6 +162,7 @@ class MemoryStore:
                     raise GetTimeoutError(f"timed out waiting for {object_id}")
                 self._cv.wait(remaining)
             entry = self._objects[object_id]
+            entry.last_access = time.monotonic()
             if entry.serialized is None:
                 entry = self._restore_locked(object_id, entry)
             if entry.shm_keys:
@@ -172,14 +179,25 @@ class MemoryStore:
     def get(self, object_id: ObjectID, timeout: float | None = None):
         with self._lock:
             if object_id in self._deser_cache:
-                return self._deser_cache[object_id]
+                # dict move-to-end: the cache's insertion order IS its LRU
+                # order, so a hit must re-rank the entry newest.
+                value = self._deser_cache.pop(object_id)
+                self._deser_cache[object_id] = value
+                entry = self._objects.get(object_id)
+                if entry is not None:
+                    entry.last_access = time.monotonic()
+                return value
         serialized = self.get_serialized(object_id, timeout)
         value = deserialize(serialized)
         with self._lock:
             # Cache only modest values to bound memory; big arrays reconstruct
-            # cheaply from their zero-copy buffers anyway.
+            # cheaply from their zero-copy buffers anyway. The cache itself is
+            # a small LRU — without the entry cap, a long-lived node serving
+            # many distinct small objects grows it without bound.
             if serialized.total_size() <= 1 << 20:
                 self._deser_cache[object_id] = value
+                while len(self._deser_cache) > self._deser_cache_cap:
+                    self._deser_cache.pop(next(iter(self._deser_cache)))
         return value
 
     def wait(
@@ -272,16 +290,18 @@ class MemoryStore:
     # -- spilling (holds lock) ------------------------------------------------
 
     def _evict_locked(self, bytes_needed: int) -> None:
-        """Spill least-recently-created unpinned objects to disk.
+        """Spill least-recently-USED unpinned objects to disk.
 
         Reference: LRU eviction (``eviction_policy.cc``) + spill orchestration
         (``local_object_manager.cc:110``). We spill rather than drop because
-        without lineage reconstruction a dropped object is lost.
+        without lineage reconstruction a dropped object is lost. Recency is
+        ``last_access`` (bumped on every read), not creation time — a hot
+        object put early must not be the first one spilled.
         """
         os.makedirs(self._spill_dir, exist_ok=True)
         candidates = sorted(
             (
-                (entry.create_time, oid)
+                (entry.last_access, oid)
                 for oid, entry in self._objects.items()
                 if entry.pinned == 0 and entry.serialized is not None
             ),
@@ -316,4 +336,14 @@ class MemoryStore:
             blob = f.read()
         entry.serialized = SerializedObject.from_bytes(blob)
         self._used += entry.size
+        if self._used > self._capacity:
+            # A restore is a write too: re-admitting the spilled bytes can
+            # push the store over capacity — spill colder entries to make
+            # room. The just-restored entry is pinned across the pass so it
+            # can't bounce straight back to disk.
+            entry.pinned += 1
+            try:
+                self._evict_locked(self._used - self._capacity)
+            finally:
+                entry.pinned -= 1
         return entry

@@ -20,7 +20,7 @@ import threading
 from typing import Optional
 
 from ray_tpu.core.config import config
-from ray_tpu.core.rpc import RpcClient, RpcConnectionError
+from ray_tpu.core.rpc import RpcClient
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("object_transfer")
@@ -52,7 +52,14 @@ class PullBudget:
 
 
 class PullManager:
-    """Chunked pulls from remote daemons into caller-provided destinations."""
+    """Chunked pulls from remote daemons into caller-provided destinations.
+
+    One object, one destination, one or MANY sources: when several replica
+    daemons hold the object (and it is at least ``stripe_min_size``),
+    :meth:`pull_into_multi` stripes the chunk ranges across all of them —
+    per-source pipelines land disjoint slices of the same destination
+    concurrently, a failed source's unfinished ranges reassign to the
+    survivors, and the pull aborts only when no replica remains."""
 
     def __init__(self, clients):
         self._clients = clients  # RpcClientPool of daemon addresses
@@ -60,58 +67,147 @@ class PullManager:
         self._chunk = cfg.pull_chunk_size
         self._window = cfg.pull_chunk_concurrency
         self._budget = PullBudget(cfg.pull_memory_budget)
+        self._stripe_min = cfg.stripe_min_size
 
     def pull_into(self, addr: str, key: bytes, size: int, dest) -> bool:
         """Pull ``size`` bytes of object ``key`` from the daemon at ``addr``
         into ``dest`` (writable buffer of exactly ``size`` bytes), as a
         pipeline of ``pull_chunk_concurrency`` in-flight chunk requests.
         Returns False on any transfer failure."""
+        return self._pull_striped([addr], key, size, dest)
+
+    def pull_into_multi(self, addrs, key: bytes, size: int, dest) -> bool:
+        """Pull one object of ``size`` bytes into ``dest`` from up to
+        ``len(addrs)`` replica daemons at once.
+
+        Below ``stripe_min_size`` the per-source pipeline setup isn't worth
+        it: sources are tried one at a time, failing over in order. Above
+        it, every source runs its own chunk pipeline over a SHARED work
+        queue of (offset, length) ranges — naturally load-balanced: a slow
+        replica simply claims fewer ranges. Returns False only when every
+        source failed with ranges outstanding."""
+        addrs = list(dict.fromkeys(addrs))
+        if not addrs:
+            return False
+        if len(addrs) > 1 and size < self._stripe_min:
+            for addr in addrs:
+                if self._pull_striped([addr], key, size, dest):
+                    return True
+            return False
+        return self._pull_striped(addrs, key, size, dest)
+
+    def _pull_striped(self, addrs, key: bytes, size: int, dest) -> bool:
+        """The one chunk pipeline: N sources over a shared range queue
+        (N=1 is the plain single-source pull — same code path, no barrier
+        or extra thread: the first source runs on the calling thread)."""
         grant = self._budget.acquire(size)
         try:
-            from ray_tpu.core.serialization import fast_copy_into
+            from collections import deque as _deque
 
-            client: RpcClient = self._clients.get(addr)
+            queue = _deque()
+            for off in range(0, size, self._chunk):
+                queue.append((off, min(self._chunk, size - off)))
+            st = {
+                "cv": threading.Condition(),
+                "queue": queue,          # unclaimed (offset, length) ranges
+                "remaining": len(queue),  # ranges not yet landed in dest
+                "live": len(addrs),      # sources still pulling
+            }
             dest_mv = memoryview(dest).cast("B")
-            offsets = list(range(0, size, self._chunk))
-            inflight = []  # (offset, future)
-            next_i = 0
-
-            def abort() -> bool:
-                # Abandoning the pull: revoke every remaining zero-copy
-                # landing FIRST — the caller will free/reuse ``dest``, and
-                # a late reply must not be received into it (rpc.py
-                # release_dests).
-                client.release_dests([f for _, _, f in inflight])
-                return False
-
-            while next_i < len(offsets) or inflight:
-                while next_i < len(offsets) and len(inflight) < self._window:
-                    off = offsets[next_i]
-                    length = min(self._chunk, size - off)
-                    # _dest: the reply's raw bytes land straight in the
-                    # arena slice — zero user-space copies on this side.
-                    inflight.append((off, length, client.call_async(
-                        "fetch_object_chunk", key, off, length,
-                        _dest=dest_mv[off:off + length])))
-                    next_i += 1
-                off, length, fut = inflight.pop(0)
-                try:
-                    chunk = fut.result(timeout=120.0)
-                except Exception:  # noqa: BLE001 — conn loss / timeout
-                    logger.warning("chunk pull %s@%d from %s failed",
-                                   key.hex()[:12], off, addr)
-                    inflight.append((off, length, fut))  # revoke this one too
-                    return abort()
-                if chunk is None:
-                    return abort()
-                if getattr(fut, "dest_written", False):
-                    continue  # already in place (direct-landing reply)
-                if len(chunk) != length:
-                    return abort()
-                fast_copy_into(dest, off, chunk)
-            return True
+            threads = [
+                threading.Thread(target=self._source_worker,
+                                 args=(addr, key, dest_mv, st),
+                                 name="pull-stripe", daemon=True)
+                for addr in addrs[1:]
+            ]
+            for t in threads:
+                t.start()
+            self._source_worker(addrs[0], key, dest_mv, st)
+            for t in threads:
+                t.join()
+            with st["cv"]:
+                return st["remaining"] == 0
         finally:
             self._budget.release(grant)
+
+    def _source_worker(self, addr: str, key: bytes, dest, st) -> None:
+        """One source's chunk pipeline over the shared range queue."""
+        from ray_tpu.core.serialization import fast_copy_into
+
+        try:
+            client = self._clients.get(addr)
+        except Exception:  # noqa: BLE001 — pool rejects bad address
+            self._source_failed(st, addr, None, [], [])
+            return
+        inflight = []  # (offset, length, future)
+        taken = []     # ranges claimed under the lock, not yet issued
+        while True:
+            with st["cv"]:
+                while (len(inflight) + len(taken) < self._window
+                       and st["queue"]):
+                    taken.append(st["queue"].popleft())
+                if not taken and not inflight:
+                    if st["remaining"] == 0 or st["live"] == 0:
+                        return
+                    # Queue drained but other sources still own ranges —
+                    # wait in case a failure reassigns them to us.
+                    st["cv"].wait(0.1)
+                    continue
+            while taken:
+                off, length = taken[0]
+                try:
+                    # _dest: the reply lands straight in the dest slice.
+                    fut = client.call_async(
+                        "fetch_object_chunk", key, off, length,
+                        _dest=dest[off:off + length])
+                except Exception:  # noqa: BLE001 — source unreachable
+                    self._source_failed(st, addr, client, inflight, taken)
+                    return
+                taken.pop(0)
+                inflight.append((off, length, fut))
+            off, length, fut = inflight.pop(0)
+            try:
+                chunk = fut.result(timeout=120.0)
+            except Exception:  # noqa: BLE001 — conn loss / timeout
+                inflight.append((off, length, fut))  # revoke this one too
+                self._source_failed(st, addr, client, inflight, taken)
+                return
+            if chunk is None or (not getattr(fut, "dest_written", False)
+                                 and len(chunk) != length):
+                # Replica gone at this source (or truncated read): this
+                # range is UNFINISHED too — back into the pool with the
+                # rest, or remaining never reaches 0 and survivors wait
+                # forever.
+                inflight.append((off, length, fut))
+                self._source_failed(st, addr, client, inflight, taken)
+                return
+            if not getattr(fut, "dest_written", False):
+                fast_copy_into(dest, off, chunk)
+            with st["cv"]:
+                st["remaining"] -= 1
+                if st["remaining"] == 0:
+                    st["cv"].notify_all()
+
+    def _source_failed(self, st, addr: str, client, inflight, taken) -> None:
+        """Reassign a dead source's unfinished ranges to the survivors.
+
+        Its zero-copy landings are revoked FIRST (release_dests) so a late
+        reply can never race a survivor's re-fetch into the same slice."""
+        if client is not None and inflight:
+            try:
+                client.release_dests([f for _, _, f in inflight])
+            except Exception:  # noqa: BLE001 — connection already torn down
+                pass
+        with st["cv"]:
+            for off, length, _f in inflight:
+                st["queue"].append((off, length))
+            for rng in taken:
+                st["queue"].append(rng)
+            st["live"] -= 1
+            st["cv"].notify_all()
+        logger.warning("pull source %s failed; %s", addr,
+                       "ranges reassigned to survivors" if st["live"]
+                       else "no replica remains — pull aborted")
 
 
 class PushManager:

@@ -336,24 +336,32 @@ class WorkerService:
                 pass  # inner owner gone; ref is lost regardless
         return out
 
-    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
-        def resolve(arg):
-            if arg.is_ref:
-                value = self.core._get_one(
-                    ObjectRef(arg.object_id,
-                              owner_hint=getattr(arg, "owner_addr", None)),
-                    None)
-                if isinstance(value, (TaskError, TaskCancelledError, ActorError)):
-                    raise _DependencyFailed(value)
-                return value
-            return arg.value
+    @staticmethod
+    def _arg_refs(spec: TaskSpec) -> List[ObjectRef]:
+        """The spec's top-level ref arguments, in positional order."""
+        return [ObjectRef(a.object_id,
+                          owner_hint=getattr(a, "owner_addr", None))
+                for a in list(spec.args) + list(spec.kwargs.values())
+                if a.is_ref]
 
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        """Resolve every argument; ref args fetch CONCURRENTLY through the
+        core's batched get (one locate round trip, bounded fan-out) instead
+        of one blocking fetch per ref."""
+        refs = self._arg_refs(spec)
         try:
-            args = [resolve(a) for a in spec.args]
-            kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+            values = self.core.resolve_refs(refs) if refs else []
+            for value in values:
+                if isinstance(value,
+                              (TaskError, TaskCancelledError, ActorError)):
+                    raise _DependencyFailed(value)
+            it = iter(values)
+            args = [next(it) if a.is_ref else a.value for a in spec.args]
+            kwargs = {k: next(it) if v.is_ref else v.value
+                      for k, v in spec.kwargs.items()}
         finally:
             # One reacquire for the whole dependency batch (the hooks are
-            # idempotent; _get_one only releases).
+            # idempotent; the fetches only release).
             if self.core.unblocked_after_get is not None:
                 self.core.unblocked_after_get()
         return args, kwargs
@@ -543,6 +551,13 @@ class WorkerService:
             return self._package_error(
                 spec, ActorError(spec.actor_id.hex(),
                                  "actor not hosted by this worker"))
+        # Task-arg prefetch: kick off concurrent resolution of the call's
+        # ref args NOW, so the dependency fetch overlaps however long this
+        # call queues behind its predecessors in _admit_in_order (instead
+        # of starting serially inside _resolve_args after admission).
+        refs = self._arg_refs(spec)
+        if refs:
+            self.core.prefetch_refs(refs)
         # Serial actors (max_concurrency=1) promise per-caller EXECUTION
         # order, not just admission order: the admission cursor advances
         # only after this call completes (the ``finally`` below). Bumping

@@ -51,13 +51,14 @@ class _MemoryBudget:
         self.max_in_flight = max_in_flight
         self._avg = 1 * 1024 * 1024  # prior: 1MB blocks
         self._samples = 0
+        self._seen = 0
         self.stages = 1
 
     def note_block(self, ref) -> None:
         # Size probes are a GCS RPC — sample the first blocks to learn the
         # shape, then only every 32nd, so the estimate stays fresh without
         # a control-plane round trip per block on the streaming hot path.
-        self._seen = getattr(self, "_seen", 0) + 1
+        self._seen += 1
         if self._samples >= 8 and self._seen % 32 != 0:
             return
         size = _ref_size(ref)
