@@ -158,6 +158,22 @@ class Config:
     # a re-send of the ~300-byte template.
     spec_cache_size = _Flag(4096)
 
+    # -- eager collectives ----------------------------------------------------
+    # Two-level topology-aware collectives: ranks sharing a node store reduce
+    # intra-node through shm first (leader accumulates in place over peers'
+    # zero-copy views), node leaders run the inter-node ring (size/num_nodes
+    # bytes per node instead of per rank), results fan back out by shm key.
+    # 0 restores the flat topology-blind ring on every group member.
+    collective_hierarchy_enabled = _Flag(True)
+    # Segment size for the pipelined inter-node ring: each ring chunk moves
+    # as segments of this many bytes, double-buffered so segment k's
+    # reduction overlaps segment k+1's transfer.
+    collective_segment_size = _Flag(1 * 1024 * 1024)
+    # Timeout for every blocking collective step (member-mailbox take, ring
+    # recv, p2p recv without an explicit timeout). Short-lived jobs and
+    # tests lower this to fail fast on a lost rank.
+    collective_timeout_s = _Flag(120.0)
+
     # -- TPU ------------------------------------------------------------------
     # Logical chips per host for resource autodetection when no TPU present
     # (reference python/ray/_private/accelerators/tpu.py:13-46 — 4 chips/host).

@@ -1614,9 +1614,22 @@ class CoreWorker:
                 time.sleep(0.05)
                 continue
             idle_since = None
+            # Server-side subscription filter: ship the oid set we are
+            # actually blocked on, so an unrelated seal neither wakes the
+            # parked poll on the GCS nor crosses the wire. A waiter that
+            # registers WHILE this poll is parked is not in the server-side
+            # wait lists yet, so its seal can't cut the poll short — the
+            # poll timeout (2s, vs 5s unfiltered pre-filter) bounds that
+            # stale-filter window, the replay below recovers the missed
+            # messages, and the waiter's own ~4 Hz locate fallback covers
+            # the latency gap meanwhile.
+            with self._loc_lock:
+                oids = [o.binary() for o in self._loc_waiters]
+            prev_cursor, prev_oids = cursor, set(oids)
             try:
                 cursor, messages = self._gcs_rpc.call(
-                    "subscribe_object_locations", cursor, 5.0, timeout=35.0)
+                    "subscribe_object_locations", cursor, 2.0, oids,
+                    timeout=35.0)
             except (RpcConnectionError, TimeoutError):
                 cursor = None  # GCS restarted: resync from 'now'
                 time.sleep(0.5)
@@ -1624,15 +1637,33 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 — e.g. mid-shutdown teardown
                 time.sleep(0.5)
                 continue
-            if not messages:
-                continue
+            self._deliver_loc_messages(messages)
+            # Waiters that registered WHILE the poll was parked: their seals
+            # may have been filtered out of the window just consumed —
+            # replay that window for the new oids only (non-blocking).
             with self._loc_lock:
-                for oid_bytes, node_id, addr, size in messages:
-                    waiters = self._loc_waiters.get(ObjectID(oid_bytes))
-                    if waiters and addr:
-                        for w in waiters:
-                            w.locations = [(node_id, addr, size)]
-                            w.event.set()
+                fresh = [o.binary() for o in self._loc_waiters
+                         if o.binary() not in prev_oids]
+            if fresh and prev_cursor is not None and cursor is not None \
+                    and cursor > prev_cursor:
+                try:
+                    _, replay = self._gcs_rpc.call(
+                        "subscribe_object_locations", prev_cursor, 0.0,
+                        fresh, timeout=10.0)
+                except Exception:  # noqa: BLE001 — fallback poll covers it
+                    replay = []
+                self._deliver_loc_messages(replay)
+
+    def _deliver_loc_messages(self, messages) -> None:
+        if not messages:
+            return
+        with self._loc_lock:
+            for oid_bytes, node_id, addr, size in messages:
+                waiters = self._loc_waiters.get(ObjectID(oid_bytes))
+                if waiters and addr:
+                    for w in waiters:
+                        w.locations = [(node_id, addr, size)]
+                        w.event.set()
 
     def _maybe_recover(self, oid: ObjectID) -> bool:
         """Resubmit the task that created ``oid`` (lineage reconstruction)."""

@@ -124,11 +124,17 @@ class GcsService:
         self._actor_leases: Dict[ActorID, str] = {}  # held for actor lifetime
         self._actor_cv = threading.Condition(self._lock)
         self._daemons = RpcClientPool()
-        # pubsub as an append-only log per channel, served by long-poll
+        # pubsub as an append-only log per channel, served by long-poll.
+        # Wait lists are PER CHANNEL (a publish wakes only that channel's
+        # parked polls, not every subscriber on one condvar), and filtered
+        # object-location subscribes additionally park on PER-OID wait
+        # lists so a seal wakes only the polls subscribed to that oid.
         self._pub_lock = threading.Lock()
-        self._pub_cv = threading.Condition(self._pub_lock)
+        self._pub_conds: Dict[str, threading.Condition] = {}
         self._pub_log: Dict[str, List[Any]] = {}
         self._pub_base: Dict[str, int] = {}  # messages truncated off the front
+        # oid bytes -> conditions of filtered subscribes parked on it
+        self._loc_waitlists: Dict[bytes, List[threading.Condition]] = {}
         self._snapshot_path = snapshot_path
         self._snapshot_seq = 0
         self._stopped = threading.Event()
@@ -802,19 +808,60 @@ class GcsService:
             return out
 
     def subscribe_object_locations(self, cursor: Optional[int],
-                                   timeout: float = 30.0):
+                                   timeout: float = 30.0,
+                                   oids: Optional[List[bytes]] = None):
         """Long-poll the object-location channel from ``cursor``; returns
         ``(next_cursor, [(oid, node_id, addr, size), ...])``.
 
         ``cursor=None`` tails from NOW: returns the current end cursor with
         no messages (subscribers use it to start, and to resync after a GCS
-        restart without replaying the retained log)."""
-        with self._pub_cv:
-            log = self._pub_log.get(self._OBJ_LOC_CHANNEL, [])
-            end = self._pub_base.get(self._OBJ_LOC_CHANNEL, 0) + len(log)
+        restart without replaying the retained log).
+
+        ``oids`` is the server-side subscription filter: only seals of those
+        object ids are returned (the cursor still advances past misses), and
+        the poll parks on PER-OID wait lists — a seal of an unrelated object
+        neither wakes this handler nor ships it a message (the reference's
+        per-key pubsub index, ``src/ray/pubsub/publisher.h``). ``None``
+        preserves the unfiltered firehose."""
+        channel = self._OBJ_LOC_CHANNEL
+        with self._pub_lock:
+            log = self._pub_log.get(channel, [])
+            end = self._pub_base.get(channel, 0) + len(log)
         if cursor is None:
             return end, []
-        return self.poll_channel(self._OBJ_LOC_CHANNEL, cursor, timeout)
+        if oids is None:
+            return self.poll_channel(channel, cursor, timeout)
+        oidset = {bytes(o) for o in oids}
+        deadline = time.time() + timeout
+        cond = threading.Condition(self._pub_lock)
+        with self._pub_lock:
+            for o in oidset:
+                self._loc_waitlists.setdefault(o, []).append(cond)
+            try:
+                while True:
+                    log = self._pub_log.get(channel, [])
+                    base = self._pub_base.get(channel, 0)
+                    end = base + len(log)
+                    if cursor < end:
+                        matches = [m for m in log[max(0, cursor - base):]
+                                   if bytes(m[0]) in oidset]
+                        cursor = end  # filtered misses are consumed too
+                        if matches:
+                            return end, matches
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return cursor, []
+                    cond.wait(timeout=remaining)
+            finally:
+                for o in oidset:
+                    lst = self._loc_waitlists.get(o)
+                    if lst is not None:
+                        try:
+                            lst.remove(cond)
+                        except ValueError:
+                            pass
+                        if not lst:
+                            self._loc_waitlists.pop(o, None)
 
     def get_lineage(self, object_id: bytes) -> Optional[bytes]:
         with self._lock:
@@ -896,14 +943,24 @@ class GcsService:
     # ====================== pubsub (long-poll) ======================
 
     def _publish(self, channel: str, message: Any) -> None:
-        with self._pub_cv:
+        with self._pub_lock:
             self._pub_log.setdefault(channel, []).append(message)
             log = self._pub_log[channel]
             if len(log) > 10_000:
                 drop = len(log) // 2
                 del log[:drop]
                 self._pub_base[channel] = self._pub_base.get(channel, 0) + drop
-            self._pub_cv.notify_all()
+            # Per-channel wait list: only this channel's parked polls wake.
+            cond = self._pub_conds.get(channel)
+            if cond is not None:
+                cond.notify_all()
+            if channel == self._OBJ_LOC_CHANNEL:
+                # Per-oid wait list: only filtered subscribes watching THIS
+                # object wake; every other parked subscribe stays asleep.
+                waiters = self._loc_waitlists.get(bytes(message[0]))
+                if waiters:
+                    for c in waiters:
+                        c.notify_all()
 
     def publish(self, channel: str, message: Any) -> None:
         self._publish(channel, message)
@@ -918,7 +975,11 @@ class GcsService:
         reference's bounded pubsub buffers).
         """
         deadline = time.time() + timeout
-        with self._pub_cv:
+        with self._pub_lock:
+            cond = self._pub_conds.get(channel)
+            if cond is None:
+                cond = self._pub_conds[channel] = threading.Condition(
+                    self._pub_lock)
             while True:
                 log = self._pub_log.get(channel, [])
                 base = self._pub_base.get(channel, 0)
@@ -930,7 +991,7 @@ class GcsService:
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     return cursor, []
-                self._pub_cv.wait(timeout=remaining)
+                cond.wait(timeout=remaining)
 
     # ====================== persistence ======================
 

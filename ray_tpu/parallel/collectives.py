@@ -15,28 +15,85 @@ Analog of the reference's ``ray.util.collective``
   (``nccl_collective_group.py``). The local backend synchronizes ranks with
   barriers and reduces with numpy; it is the Gloo analog and the test
   substrate for multi-host DCN collectives.
+
+The cross-process backend is TOPOLOGY-AWARE, mirroring the two physical
+tiers of a TPU pod (fast ICI inside a slice, slower DCN between hosts):
+ranks that share a node store (the ICI analog) reduce intra-node through
+shm first, node LEADERS run the inter-node ring (the DCN analog) moving
+size/num_nodes bytes per node instead of per rank, and results fan back
+out intra-node by shm key — the reduce-local / cross-once / broadcast-local
+recipe of arXiv:2011.03641 §4 and Podracer (arXiv:2104.06272).
+``collective_hierarchy_enabled=0`` restores the flat topology-blind ring.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.core.config import config as _get_config
 from ray_tpu.core.runtime import get_runtime
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("collectives")
 
-_REDUCE_OPS = {
-    "sum": lambda arrs: np.sum(arrs, axis=0),
-    "prod": lambda arrs: np.prod(arrs, axis=0),
-    "min": lambda arrs: np.min(arrs, axis=0),
-    "max": lambda arrs: np.max(arrs, axis=0),
-    "mean": lambda arrs: np.mean(arrs, axis=0),
+# In-place accumulation kernels: every reduce site accumulates with ufunc
+# ``out=`` into a private buffer (mean = sum + one final in-place divide)
+# instead of stacking contributions and reducing the stack — no O(world)
+# temporary per step.
+_UFUNCS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
 }
+
+
+def _acc_dtype(dtype, op: str) -> np.dtype:
+    """Accumulator dtype matching numpy's stack-then-reduce promotion rules
+    (``np.sum``/``np.prod`` widen sub-word ints to the platform int;
+    ``np.mean`` of integral input is float64) so the in-place kernels return
+    the same dtypes the old ``np.sum(arrs, axis=0)`` path did."""
+    dtype = np.dtype(dtype)
+    if op == "mean":
+        return dtype if np.issubdtype(dtype, np.inexact) else np.dtype(np.float64)
+    if op in ("sum", "prod"):
+        if dtype.kind in "bi":
+            return np.result_type(dtype, np.int_)
+        if dtype.kind == "u":
+            return np.result_type(dtype, np.uint)
+    return dtype
+
+
+def _reduce_inplace(op: str, arrs):
+    """Reduce a list of arrays with in-place ufunc accumulation. The inputs
+    are never mutated: the first contribution is copied into a private
+    accumulator (promoting per :func:`_acc_dtype`), the rest accumulate with
+    ``out=``. float16 mean keeps ``np.mean``'s float32 intermediate (cast
+    back at the end) so half-precision results don't round per
+    contribution."""
+    acc_op = "sum" if op == "mean" else op
+    first = np.asarray(arrs[0])
+    out_dt = _acc_dtype(first.dtype, op)
+    acc_dt = (np.dtype(np.float32)
+              if op == "mean" and out_dt == np.float16 else out_dt)
+    acc = first.astype(acc_dt, copy=True)
+    uf = _UFUNCS[acc_op]
+    for a in arrs[1:]:
+        uf(acc, a, out=acc)
+    if op == "mean":
+        np.divide(acc, len(arrs), out=acc)
+    return acc if acc_dt == out_dt else acc.astype(out_dt)
+
+
+# Public op table (kept for the op-validation contract): each entry reduces
+# a LIST of per-rank arrays, now via the in-place kernels above.
+_REDUCE_OPS = {op: functools.partial(_reduce_inplace, op)
+               for op in ("sum", "prod", "min", "max", "mean")}
 
 
 def _device_allreduce(slots: Dict[int, "object"], op: str, world: int):
@@ -49,7 +106,6 @@ def _device_allreduce(slots: Dict[int, "object"], op: str, world: int):
     NCCL-group analog; on TPU hardware the reduction rides ICI)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     ranks = sorted(slots)
@@ -81,9 +137,6 @@ def _device_allreduce(slots: Dict[int, "object"], op: str, world: int):
         idx = devices.index(shard.device)
         per[ranks[idx]] = shard.data[0]
     return per
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=64)
@@ -138,6 +191,7 @@ class _GroupState:
         self.result = None
         self.arrived = 0
         self.departed = 0
+        self._timeout = float(_get_config().collective_timeout_s)
         # Point-to-point mailboxes: (src, dst) -> list of arrays.
         self.p2p: Dict[tuple, List[np.ndarray]] = {}
 
@@ -149,7 +203,7 @@ class _GroupState:
             # this drain guard its deposit lands in (and is wiped with) the
             # old round — mixed-epoch corruption.
             while self.arrived == self.world_size or rank in self.slots:
-                if not self.cv.wait(timeout=60.0):
+                if not self.cv.wait(timeout=self._timeout):
                     raise TimeoutError(
                         f"collective drain timed out at rank {rank} "
                         f"(prev round: {self.departed}/{self.world_size} departed)"
@@ -162,7 +216,7 @@ class _GroupState:
                 self.cv.notify_all()
             else:
                 while self.epoch == epoch and self.arrived < self.world_size:
-                    if not self.cv.wait(timeout=60.0):
+                    if not self.cv.wait(timeout=self._timeout):
                         raise TimeoutError(
                             f"collective timed out at rank {rank} "
                             f"({self.arrived}/{self.world_size} arrived)"
@@ -189,7 +243,9 @@ class _GroupState:
             self.p2p.setdefault((src, dst), []).append(value)
             self.cv.notify_all()
 
-    def p2p_recv(self, src: int, dst: int, timeout: float = 60.0):
+    def p2p_recv(self, src: int, dst: int, timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = self._timeout
         key = (src, dst)
         with self.cv:
             while not self.p2p.get(key):
@@ -254,6 +310,39 @@ def _compute_for(descriptor: tuple, world: int):
     raise ValueError(f"unknown collective descriptor {descriptor}")
 
 
+class _Topology:
+    """rank → node grouping for one cross-process group, derived from the
+    store names every rank rendezvoused through the GCS group KV: ranks
+    publishing the same (non-empty) node-store name share a node — the ICI
+    analog; distinct stores are separated by the DCN analog. A rank with no
+    reachable store is its own singleton node (no zero-copy plane to share).
+    """
+
+    def __init__(self, stores: List[Optional[str]]):
+        key_to_idx: Dict[object, int] = {}
+        self.node_of: List[int] = []
+        for r, s in enumerate(stores):
+            key = s if s else ("#solo", r)
+            idx = key_to_idx.setdefault(key, len(key_to_idx))
+            self.node_of.append(idx)
+        self.nodes: List[List[int]] = [[] for _ in key_to_idx]
+        for r, idx in enumerate(self.node_of):
+            self.nodes[idx].append(r)
+        # Node leader = lowest rank sharing the store; leaders alone run the
+        # inter-node ring.
+        self.leaders = [g[0] for g in self.nodes]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def multi_rank_nodes(self) -> bool:
+        """True when at least one node hosts >1 rank — the only shape where
+        the two-level schedule differs from (and beats) the flat ring."""
+        return any(len(g) > 1 for g in self.nodes)
+
+
 class _ShmIncoming:
     """A chunk delivered by shm reference: the array is a zero-copy view
     into the node's object store; ``close()`` releases the view and acks
@@ -278,6 +367,9 @@ class _ShmIncoming:
             pass
 
 
+_TAKE_DEFAULT = object()  # sentinel: "use the service's configured timeout"
+
+
 class _MemberService:
     """Every rank's RPC surface in the cross-process backend: a tagged
     mailbox. Peers deliver (tag -> payload) messages; the local rank waits
@@ -294,6 +386,9 @@ class _MemberService:
         self.cv = threading.Condition(self.lock)
         self.box: Dict[tuple, object] = {}
         self.shm = None  # set by the group when a node store is reachable
+        # Default blocking-take timeout; the owning group overrides it with
+        # its collective_timeout_s.
+        self.default_timeout: Optional[float] = 120.0
         # Origin-side: shm chunks awaiting consumer acks -> pending count.
         self._outstanding: Dict[bytes, int] = {}
 
@@ -334,9 +429,11 @@ class _MemberService:
             except Exception:  # noqa: BLE001 — store gone at shutdown
                 pass
 
-    def take(self, tag: tuple, timeout: Optional[float] = 120.0):
+    def take(self, tag: tuple, timeout=_TAKE_DEFAULT):
         import time as _time
 
+        if timeout is _TAKE_DEFAULT:
+            timeout = self.default_timeout
         end = None if timeout is None else _time.time() + timeout
         tag = tuple(tag)
         with self.cv:
@@ -355,23 +452,42 @@ class _MemberService:
 
 
 class _DistributedGroup:
-    """One rank's view of a cross-process group: RING reduce-scatter /
-    allgather and a binomial broadcast tree over direct peer-to-peer
-    channels — each rank moves O(size) bytes per allreduce regardless of
-    world size (the rank-0 hub this replaces concentrated O(N*size) on one
-    socket). This is the host-tensor (DCN/gloo) tier of §5.8; device
-    tensors inside jitted programs use XLA collectives over ICI instead.
+    """One rank's view of a cross-process group.
+
+    Two schedules, chosen from the rendezvoused topology:
+
+    - **Two-level (default when some node hosts >1 rank):** intra-node
+      reduce through shm into the node leader's private buffer (ufunc
+      ``out=`` over peers' zero-copy views), a SEGMENTED PIPELINED ring
+      between node leaders moving size/num_nodes bytes per node over the
+      cross-node fabric, then an intra-node fan-out by shm key. This is the
+      host-side mirror of a TPU pod's ICI/DCN hierarchy (§5.8).
+    - **Flat ring** (``collective_hierarchy_enabled=0``, or no shared
+      stores): ring reduce-scatter/allgather over all ranks — each rank
+      moves O(size) bytes per allreduce regardless of world size. The
+      reduce phase is segmented the same way, so segment k's in-place
+      reduction overlaps segment k+1's transfer.
     """
 
     # Payloads at or above this ride the shm object plane between
     # same-node ranks (below it, the socket path's latency wins).
     SHM_MIN_BYTES = 1 << 20
 
+    # Class-level defaults so partially-constructed instances (unit tests
+    # build the group via ``object.__new__``) still run the flat paths.
+    _timeout = 120.0
+    _segment_bytes = 1 << 20
+    _hier = False
+    _topo: Optional[_Topology] = None
+    stats: Optional[Dict[str, int]] = None
+
     def __init__(self, world_size: int, rank: int, addrs: List[str],
                  service: _MemberService, server,
-                 stores: Optional[List[Optional[str]]] = None):
+                 stores: Optional[List[Optional[str]]] = None,
+                 hierarchy: Optional[bool] = None):
         from ray_tpu.core.rpc import RpcClientPool
 
+        cfg = _get_config()
         self.world_size = world_size
         self.rank = rank
         self._addrs = addrs
@@ -380,15 +496,32 @@ class _DistributedGroup:
         self._peers = RpcClientPool()
         self._op_seq = 0
         self._op_lock = threading.Lock()
+        self._timeout = float(cfg.collective_timeout_s)
+        self._segment_bytes = max(4096, int(cfg.collective_segment_size))
+        service.default_timeout = self._timeout
         # Same-node shm fast path: ranks publishing the same store name
-        # share one arena; big chunks cross as object keys.
+        # share one arena; big chunks cross as object keys. The stores list
+        # is the KV-RENDEZVOUSED view — identical on every rank — and it
+        # alone decides the topology/schedule; a rank whose own store failed
+        # to open published "" (so everyone, itself included, sees it as a
+        # solo node) and gates only its local shm TRANSPORT off via
+        # ``self._shm`` — zeroing the whole list here would make this rank
+        # pick the flat schedule while its peers run the hierarchy, and
+        # their tags would never pair.
         self._stores = stores or [None] * world_size
         # The store handle is opened by _init_distributed_group BEFORE the
         # rank's address is published (a peer may deliver_shm the moment it
         # can see us); here we just adopt it off the service.
         self._shm = service.shm
-        if self._shm is None:
-            self._stores = [None] * world_size
+        self._topo = _Topology(self._stores)
+        self._hier = (bool(cfg.collective_hierarchy_enabled)
+                      if hierarchy is None else bool(hierarchy))
+        # Instrumentation: logical payload bytes sent, split by whether
+        # the destination shares this rank's store (the DCN-analog
+        # "cross-store" traffic is what the hierarchy minimizes), plus
+        # which schedule each reduction round took.
+        self.stats = {"bytes_cross_store": 0, "bytes_same_store": 0,
+                      "hier_rounds": 0, "flat_rounds": 0}
         # Homogeneous single-node group: broadcast can write once and
         # circulate one key through the whole tree.
         self._all_same_store = bool(
@@ -402,12 +535,25 @@ class _DistributedGroup:
             self._op_seq += 1
             return self._op_seq
 
+    def _use_hier(self) -> bool:
+        return (self._hier and self._topo is not None
+                and self._topo.multi_rank_nodes and self.world_size > 1)
+
+    def _acct(self, dst: int, nbytes: int) -> None:
+        st = self.stats
+        if st is None or not nbytes:
+            return
+        same = (self._stores[dst] is not None
+                and self._stores[dst] == self._stores[self.rank])
+        st["bytes_same_store" if same else "bytes_cross_store"] += int(nbytes)
+
     def _send(self, dst: int, tag: tuple, value) -> None:
         if dst == self.rank:
             self._service.deliver(tag, value)
             return
+        self._acct(dst, getattr(value, "nbytes", 0))
         self._peers.get(self._addrs[dst]).call(
-            "deliver", tag, value, timeout=120.0)
+            "deliver", tag, value, timeout=self._timeout)
 
     @staticmethod
     def _bc_subtree_consumers(rel: int, n: int) -> int:
@@ -427,20 +573,20 @@ class _DistributedGroup:
             k *= 2
         return count
 
-    def _ring_shm_consumers(self, first_dst: int, hops: int) -> int:
-        """How many CONSECUTIVE downstream ring receivers (starting at
-        ``first_dst``, following +1 for ``hops`` hops) share this rank's
-        store. Only those receive the chunk BY KEY and ack; once the ring
-        crosses to a different store the chunk continues as socket copies
-        — counting those would leave the backing object undeletable."""
-        n = self.world_size
+    def _ring_shm_consumers(self, ring: List[int], start_pos: int,
+                            hops: int) -> int:
+        """How many CONSECUTIVE downstream ring receivers (starting at ring
+        position ``start_pos``, following the ring for ``hops`` hops) share
+        this rank's store. Only those receive the chunk BY KEY and ack; once
+        the ring crosses to a different store the chunk continues as socket
+        copies — counting those would leave the backing object undeletable."""
+        m = len(ring)
         count = 0
-        r = first_dst
-        for _ in range(hops):
-            if self._stores[r % n] != self._stores[self.rank]:
+        for i in range(hops):
+            r = ring[(start_pos + i) % m]
+            if self._stores[r] != self._stores[self.rank]:
                 break
             count += 1
-            r += 1
         return count
 
     def _send_async(self, dst: int, tag: tuple, value, *,
@@ -475,6 +621,7 @@ class _DistributedGroup:
                     "deliver_shm", tag, key, value.shape, value.dtype.str,
                     self.rank)
             # Arena full: fall through to the socket path.
+        self._acct(dst, getattr(value, "nbytes", 0))
         return self._peers.get(self._addrs[dst]).call_async(
             "deliver", tag, value)
 
@@ -492,6 +639,8 @@ class _DistributedGroup:
         flat[:] = np.ascontiguousarray(arr).reshape(-1)
         self._shm.seal(key)
         self._service.note_outstanding(key, consumers)
+        if self.stats is not None:
+            self.stats["bytes_same_store"] += int(arr.nbytes)
         return key
 
     def _materialize(self, incoming):
@@ -515,8 +664,34 @@ class _DistributedGroup:
             holder.close()
             self._ack_shm(holder)
 
-    def _recv(self, tag: tuple, timeout: float = 120.0):
-        return self._service.take(tag, timeout)
+    def _recv(self, tag: tuple, timeout: Optional[float] = None):
+        return self._service.take(
+            tag, self._timeout if timeout is None else timeout)
+
+    def _segment_slices(self, n_elems: int, itemsize: int) -> List[slice]:
+        """Split a 1-D chunk into ``collective_segment_size``-byte segments.
+        Both ring ends compute the same split from the (globally agreed)
+        chunk length, so segment tags pair up without negotiation."""
+        if n_elems == 0:
+            return []
+        seg = max(1, self._segment_bytes // max(1, itemsize))
+        return [slice(i, min(i + seg, n_elems))
+                for i in range(0, n_elems, seg)]
+
+    def _chunk_segments(self, peer: int, n_elems: int,
+                        itemsize: int) -> List[slice]:
+        """Segmentation policy for one ring hop: chunks CROSSING stores
+        (the inter-node / DCN-analog hop) are segmented so reduction
+        overlaps transfer; same-store chunks ride shm whole — one key, one
+        arena copy, zero-copy reduce (per-segment objects would only add
+        RPC overhead on the fast tier). Sender and receiver derive the same
+        split from the shared topology, so tags pair up."""
+        if n_elems == 0:
+            return []
+        if (self._shm is not None
+                and self._stores[peer] == self._stores[self.rank]):
+            return [slice(0, n_elems)]
+        return self._segment_slices(n_elems, itemsize)
 
     # -- collectives --------------------------------------------------------
 
@@ -524,113 +699,414 @@ class _DistributedGroup:
         assert rank == self.rank
         kind = descriptor[0]
         seq = self._next_seq()
+        hier = self._use_hier()
         if kind == "allreduce":
             return self._allreduce(seq, value, descriptor[1])
         if kind == "reducescatter":
-            reduced = self._reduce_scatter(seq, value, descriptor[1])
+            if hier:
+                reduced = self._hier_reduce_scatter(seq, value, descriptor[1])
+            else:
+                reduced = self._reduce_scatter(seq, value, descriptor[1])
             # API contract: caller indexes [rank]; return full split list
             # shape-compatible with the local backend.
             out = [None] * self.world_size
             out[self.rank] = reduced
             return out
         if kind == "allgather":
+            if hier:
+                return self._hier_allgather(seq, value)
             return self._allgather(seq, value)
         if kind == "broadcast":
+            if hier:
+                return self._hier_broadcast(seq, value, descriptor[1])
             return self._broadcast(seq, value, descriptor[1])
         if kind == "barrier":
+            # 1-byte payloads: the flat ring's latency is the floor either
+            # way; the two-level schedule only adds hops here.
             self._allgather(seq, np.zeros(1, dtype=np.uint8))
             return None
         if kind == "alltoall":
             return {self.rank: self._alltoall(seq, value)}
         raise ValueError(f"unknown collective descriptor {descriptor}")
 
-    def _ring_chunks(self, arr: np.ndarray) -> List[np.ndarray]:
-        return np.array_split(arr, self.world_size, axis=0)
+    # -- ring engine --------------------------------------------------------
 
-    def _allreduce(self, seq: int, value, op: str):
-        """Ring allreduce: reduce-scatter then allgather, 2(N-1) steps,
-        each moving ~size/N bytes per rank per step."""
-        n = self.world_size
-        if n == 1:
-            return _REDUCE_OPS[op]([np.asarray(value)])
-        arr = np.asarray(value)
-        orig_shape = arr.shape
-        arr = np.atleast_1d(arr)
-        mean = op == "mean"
-        acc_op = "sum" if mean else op
-        chunks = self._ring_chunks(arr)
-        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
-        # Phase 1 — reduce-scatter: after step s, this rank holds the
-        # running reduction of chunk (rank - s) % n over s+1 contributors.
-        for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            recv_idx = (self.rank - step - 1) % n
-            fut = self._send_async(nxt, (seq, "rs", step), chunks[send_idx])
-            arr, holder = self._materialize(self._recv((seq, "rs", step)))
-            chunks[recv_idx] = _REDUCE_OPS[acc_op]([chunks[recv_idx], arr])
-            self._finish_consume(holder)
-            if fut is not None:
-                fut.result(timeout=120.0)
-        owned = (self.rank + 1) % n  # fully reduced chunk this rank holds
-        if mean:
-            chunks[owned] = chunks[owned] / n
+    def _ring_allreduce_inplace(self, seq: int, buf: np.ndarray, acc_op: str,
+                                ring: List[int], phase: str = "r",
+                                src: Optional[np.ndarray] = None) -> None:
+        """Segmented pipelined ring allreduce over the ranks in ``ring``
+        (all of which must be calling this with the same ring),
+        accumulating IN PLACE into the 1-D ``buf`` — the caller owns the
+        buffer and applies any mean division afterwards.
+
+        ``src`` (optional, same length) carries this rank's ORIGINAL
+        contribution with ``buf`` left uninitialized: each chunk's first
+        accumulation then reads straight from the input into ``buf``
+        (``uf(src, incoming, out=buf)``) and step-0 sends ship input views
+        — the full-size private entry copy disappears.
+
+        Phase 1 (reduce-scatter) moves each store-crossing chunk as
+        ``collective_segment_size`` segments: the peer posts every segment
+        up front (persistent per-peer connection, sends overlap), so
+        segment k's in-place reduction here overlaps segment k+1's
+        transfer; same-store chunks ride shm whole. Phase 2 (allgather)
+        circulates each owner's fully-reduced chunk whole — published to
+        shm once and forwarded BY KEY between same-store ranks — and lands
+        it straight into ``buf`` (no final concatenate)."""
+        m = len(ring)
+        if m == 1:
+            if src is not None:
+                np.copyto(buf, src)
+            return
+        pos = ring.index(self.rank)
+        nxt = ring[(pos + 1) % m]
+        prv = ring[(pos - 1) % m]
+        uf = _UFUNCS[acc_op]
+        chunks = np.array_split(buf, m)  # views into buf
+        src_chunks = np.array_split(src, m) if src is not None else chunks
+        touched = [src is None] * m
+        rs_tag, ag_tag = phase + "rs", phase + "ag"
+        # Phase 1 — after step s, this rank holds the running reduction of
+        # chunk (pos - s) % m over s+1 contributors.
+        for step in range(m - 1):
+            send_idx = (pos - step) % m
+            recv_idx = (pos - step - 1) % m
+            out_chunk = (chunks if touched[send_idx]
+                         else src_chunks)[send_idx]
+            futs = [self._send_async(nxt, (seq, rs_tag, step, g),
+                                     out_chunk[sl])
+                    for g, sl in enumerate(self._chunk_segments(
+                        nxt, len(out_chunk), out_chunk.itemsize))]
+            dst = chunks[recv_idx]
+            first = not touched[recv_idx]
+            for g, sl in enumerate(self._chunk_segments(prv, len(dst),
+                                                        dst.itemsize)):
+                arr, holder = self._materialize(
+                    self._recv((seq, rs_tag, step, g)))
+                seg = dst[sl]
+                uf(src_chunks[recv_idx][sl] if first else seg, arr, out=seg)
+                self._finish_consume(holder)
+            touched[recv_idx] = True
+            for fut in futs:
+                if fut is not None:
+                    fut.result(timeout=self._timeout)
         # Phase 2 — allgather the reduced chunks around the ring. Each
         # reduced chunk is written to shm ONCE by its owner and then
-        # FORWARDED BY KEY: every rank reads the same backing object
-        # (zero-copy views, consumed by the final concatenate) and acks;
-        # the owner deletes after all n-1 consumers ack.
-        holders: List[Optional[_ShmIncoming]] = [None] * n
-        for step in range(n - 1):
-            send_idx = (self.rank + 1 - step) % n
-            recv_idx = (self.rank - step) % n
+        # FORWARDED BY KEY: every same-store rank reads the same backing
+        # object, copies its range into ``buf``, forwards, and acks.
+        holders: List[Optional[_ShmIncoming]] = [None] * m
+        for step in range(m - 1):
+            send_idx = (pos + 1 - step) % m
+            recv_idx = (pos - step) % m
             # consumers = the consecutive same-store receivers downstream
-            # of THIS send (the chunk has n-1-step hops left; once the
+            # of THIS send (the chunk has m-1-step hops left; once the
             # ring crosses stores it continues as socket copies that never
             # ack — counting them would leak the backing object).
             fut = self._send_async(
-                nxt, (seq, "ag", step), chunks[send_idx],
-                consumers=self._ring_shm_consumers(nxt, n - 1 - step),
+                nxt, (seq, ag_tag, step), chunks[send_idx],
+                consumers=self._ring_shm_consumers(ring, (pos + 1) % m,
+                                                   m - 1 - step),
                 holder=holders[send_idx])
-            arr, holder = self._materialize(self._recv((seq, "ag", step)))
-            chunks[recv_idx] = arr  # shm chunks stay zero-copy views
-            holders[recv_idx] = holder
+            arr, holder = self._materialize(self._recv((seq, ag_tag, step)))
+            np.copyto(chunks[recv_idx], arr)
+            holders[recv_idx] = holder  # kept for the key-forward next step
             if fut is not None:
-                fut.result(timeout=120.0)
-        result = np.concatenate([np.atleast_1d(c) for c in chunks], axis=0)
+                fut.result(timeout=self._timeout)
         for h in holders:
             self._finish_consume(h)
-        return result.reshape(orig_shape)
+
+    def _allreduce(self, seq: int, value, op: str):
+        n = self.world_size
+        arr = np.asarray(value)
+        if n == 1:
+            return _REDUCE_OPS[op]([arr])
+        orig_shape = arr.shape
+        arr = np.atleast_1d(arr)
+        acc_op = "sum" if op == "mean" else op
+        if self._use_hier():
+            return self._hier_allreduce(seq, arr, acc_op,
+                                        op).reshape(orig_shape)
+        if self.stats is not None:
+            self.stats["flat_rounds"] += 1
+        # Private working buffer; the caller's input is never mutated. When
+        # no dtype promotion is needed, the buffer starts EMPTY and each
+        # chunk's first accumulation reads the input directly (``src``) —
+        # no full-size entry copy. Promoting ops (int sum/prod, int mean)
+        # pre-copy so every accumulation runs in the promoted dtype.
+        acc_dt = _acc_dtype(arr.dtype, op)
+        flat_in = np.ascontiguousarray(arr).reshape(-1)
+        if flat_in.dtype == acc_dt:
+            buf = np.empty(flat_in.size, dtype=acc_dt)
+            self._ring_allreduce_inplace(seq, buf, acc_op, list(range(n)),
+                                         src=flat_in)
+        else:
+            buf = flat_in.astype(acc_dt)
+            self._ring_allreduce_inplace(seq, buf, acc_op, list(range(n)))
+        if op == "mean":
+            np.divide(buf, n, out=buf)
+        return buf.reshape(orig_shape)
+
+    # -- two-level schedule -------------------------------------------------
+
+    def _reduce_to_leader(self, seq: int, arr: np.ndarray, acc_op: str,
+                          op: str) -> Optional[np.ndarray]:
+        """Intra-node reduce (the ICI-analog tier): non-leaders ship their
+        ORIGINAL array to the node leader — by shm reference when big
+        enough, with no intermediate promote-copy — and the leader
+        accumulates IN PLACE into a private promoted buffer over the
+        incoming zero-copy views. Returns that buffer on the leader;
+        non-leaders return None and await the fan-out."""
+        topo = self._topo
+        group = topo.nodes[topo.node_of[self.rank]]
+        leader = group[0]
+        if self.rank != leader:
+            fut = self._send_async(leader, (seq, "hup", self.rank),
+                                   np.ascontiguousarray(arr))
+            if fut is not None:
+                fut.result(timeout=self._timeout)
+            return None
+        acc_dt = _acc_dtype(arr.dtype, op)
+        uf = _UFUNCS[acc_op]
+        buf = None
+        for peer in group[1:]:
+            inc, holder = self._materialize(self._recv((seq, "hup", peer)))
+            if buf is not None:
+                uf(buf, inc.reshape(buf.shape), out=buf)
+            elif arr.dtype == acc_dt:
+                # First accumulation ALLOCATES the private buffer (one
+                # fused read-read-write pass instead of copy-then-add).
+                buf = uf(arr, inc.reshape(arr.shape), dtype=acc_dt)
+            else:
+                buf = arr.astype(acc_dt, order="C", copy=True)
+                uf(buf, inc.reshape(buf.shape), out=buf)
+            self._finish_consume(holder)
+        if buf is None:  # leader with no node peers
+            buf = arr.astype(acc_dt, order="C", copy=True)
+        # The inter-node ring and fan-out flatten this buffer with
+        # reshape(-1), which must be a VIEW: a non-C-contiguous buffer
+        # (astype order='K' preserves an F-ordered input's layout) would
+        # silently detach the flat copy from buf.
+        if not buf.flags.c_contiguous:
+            buf = np.ascontiguousarray(buf)
+        return buf
+
+    def _take_down(self, seq: int, tag: str):
+        """Receive a fan-out result: a socket-delivered payload is already
+        private and returns WITHOUT a copy; only shm views (whose backing
+        object dies with the ack) detach."""
+        inc, holder = self._materialize(self._recv((seq, tag, self.rank)))
+        if holder is not None:
+            inc = np.array(inc)
+            self._finish_consume(holder)
+        return inc
+
+    def _fan_out(self, seq: int, tag: str, arr: np.ndarray,
+                 peers: List[int]) -> None:
+        """Intra-node distribution: publish ``arr`` to shm ONCE and hand
+        every peer the key (each acks; the object dies after the last),
+        falling back to per-peer socket sends when small or arena-full."""
+        if not peers:
+            return
+        futs = []
+        key = None
+        if (self._shm is not None and isinstance(arr, np.ndarray)
+                and arr.nbytes >= self.SHM_MIN_BYTES):
+            key = self._publish_shm(arr, len(peers))
+        for p in peers:
+            if key is not None:
+                futs.append(self._peers.get(self._addrs[p]).call_async(
+                    "deliver_shm", (seq, tag, p), key, arr.shape,
+                    arr.dtype.str, self.rank))
+            else:
+                futs.append(self._send_async(p, (seq, tag, p), arr))
+        for fut in futs:
+            if fut is not None:
+                fut.result(timeout=self._timeout)
+
+    def _hier_allreduce(self, seq: int, arr: np.ndarray, acc_op: str,
+                        op: str) -> np.ndarray:
+        """Two-level allreduce of ``arr`` (atleast-1d, never mutated):
+        intra-node reduce at the leader, segmented ring between node
+        leaders moving size/num_nodes bytes per node across the slow
+        fabric, one final in-place mean divide, then fan-out by shm key."""
+        if self.stats is not None:
+            self.stats["hier_rounds"] += 1
+        topo = self._topo
+        group = topo.nodes[topo.node_of[self.rank]]
+        buf = self._reduce_to_leader(seq, arr, acc_op, op)
+        if buf is None:
+            return self._take_down(seq, "hdn").reshape(arr.shape)
+        flat = buf.reshape(-1)
+        if topo.num_nodes > 1:
+            self._ring_allreduce_inplace(seq, flat, acc_op, topo.leaders,
+                                         phase="h")
+        if op == "mean":
+            np.divide(flat, self.world_size, out=flat)
+        self._fan_out(seq, "hdn", flat, group[1:])
+        return buf
+
+    def _hier_reduce_scatter(self, seq: int, value, op: str):
+        """Two-level reduce-scatter: intra-node reduce to the leader,
+        leaders allreduce over the ring, leader hands each node peer ONLY
+        its own ``slots[rank]`` slice (zero-copy by shm key when big)."""
+        n = self.world_size
+        arr = np.asarray(value)
+        acc_op = "sum" if op == "mean" else op
+        topo = self._topo
+        group = topo.nodes[topo.node_of[self.rank]]
+        buf = self._reduce_to_leader(seq, arr, acc_op, op)
+        if buf is None:
+            return self._take_down(seq, "hdn")
+        if topo.num_nodes > 1:
+            self._ring_allreduce_inplace(seq, buf.reshape(-1), acc_op,
+                                         topo.leaders, phase="h")
+        if op == "mean":
+            np.divide(buf, n, out=buf)
+        split = np.array_split(buf, n, axis=0)
+        futs = [self._send_async(p, (seq, "hdn", p), split[p])
+                for p in group[1:]]
+        for fut in futs:
+            if fut is not None:
+                fut.result(timeout=self._timeout)
+        return split[self.rank]
+
+    def _hier_allgather(self, seq: int, value) -> List[np.ndarray]:
+        """Two-level allgather: each node's leader collects its ranks'
+        arrays, leaders circulate ONE block per node around their ring
+        (each node's data crosses the slow fabric once per hop instead of
+        once per rank), and leaders hand the assembled result back down."""
+        topo = self._topo
+        n = self.world_size
+        group = topo.nodes[topo.node_of[self.rank]]
+        leader = group[0]
+        arr = np.asarray(value)
+        if self.rank != leader:
+            fut = self._send_async(leader, (seq, "gup", self.rank), arr)
+            if fut is not None:
+                fut.result(timeout=self._timeout)
+            # Equal-shape results arrive STACKED as one ndarray (published
+            # to shm once per node by the leader); ragged results arrive as
+            # a pickled list over the socket.
+            got = self._recv((seq, "gdn", self.rank))
+            if isinstance(got, list):
+                return got
+            stacked, holder = self._materialize(got)
+            if holder is not None:
+                stacked = np.array(stacked)  # detach from shm before ack
+                self._finish_consume(holder)
+            return [stacked[i] for i in range(len(stacked))]
+        block = {self.rank: arr}
+        for peer in group[1:]:
+            a, holder = self._materialize(self._recv((seq, "gup", peer)))
+            if holder is not None:
+                a = np.array(a)  # kept past the step: detach from shm
+                self._finish_consume(holder)
+            block[peer] = a
+        blocks = {topo.node_of[self.rank]: block}
+        ring = topo.leaders
+        m = len(ring)
+        if m > 1:
+            pos = ring.index(self.rank)
+            nxt = ring[(pos + 1) % m]
+            carry = (topo.node_of[self.rank], block)
+            for step in range(m - 1):
+                fut = self._send_async(nxt, (seq, "hga", step), carry)
+                carry = self._recv((seq, "hga", step))
+                blocks[carry[0]] = carry[1]
+                if fut is not None:
+                    fut.result(timeout=self._timeout)
+        out: List[Optional[np.ndarray]] = [None] * n
+        for blk in blocks.values():
+            for r, a in blk.items():
+                out[r] = a
+        if group[1:]:
+            same = all(isinstance(a, np.ndarray) and a.shape == out[0].shape
+                       and a.dtype == out[0].dtype for a in out)
+            if same:
+                # One stacked array fans down by shm key (one arena write
+                # per node) instead of pickling the full result list once
+                # per peer through the socket.
+                self._fan_out(seq, "gdn", np.stack(out), group[1:])
+            else:
+                futs = [self._send_async(p, (seq, "gdn", p), out)
+                        for p in group[1:]]
+                for fut in futs:
+                    if fut is not None:
+                        fut.result(timeout=self._timeout)
+        return out  # type: ignore[return-value]
+
+    def _hier_broadcast(self, seq: int, value, src: int):
+        """Two-level broadcast: the root sends ONE copy per remote node (to
+        its leader, crossing the slow fabric once per node), and every
+        node's distributor fans out intra-node by shm key."""
+        topo = self._topo
+        my_node = topo.node_of[self.rank]
+        src_node = topo.node_of[src]
+        if self.rank == src:
+            arr = np.asarray(value)
+            futs = []
+            for nidx, grp in enumerate(topo.nodes):
+                if nidx == src_node:
+                    continue
+                futs.append(self._send_async(grp[0], (seq, "hbc", grp[0]),
+                                             arr))
+            # The root distributes within its own node (even when it is not
+            # the node leader — one fewer intra-node hop).
+            self._fan_out(seq, "hbc", arr,
+                          [r for r in topo.nodes[src_node] if r != src])
+            for fut in futs:
+                if fut is not None:
+                    fut.result(timeout=self._timeout)
+            return arr
+        arr, holder = self._materialize(self._recv((seq, "hbc", self.rank)))
+        if my_node != src_node and self.rank == topo.nodes[my_node][0]:
+            self._fan_out(seq, "hbc", arr,
+                          [r for r in topo.nodes[my_node] if r != self.rank])
+        if holder is not None:
+            arr = np.array(arr)  # result is returned to the caller
+            self._finish_consume(holder)
+        return arr
+
+    # -- flat schedule ------------------------------------------------------
 
     def _reduce_scatter(self, seq: int, value, op: str):
         n = self.world_size
         arr = np.asarray(value)
         if n == 1:
             return _REDUCE_OPS[op]([arr])
-        mean = op == "mean"
-        acc_op = "sum" if mean else op
-        chunks = self._ring_chunks(arr)
+        acc_op = "sum" if op == "mean" else op
+        # Private promoted copy: ring steps accumulate in place into its
+        # chunk views (axis-0 split — the slots[rank] contract).
+        buf = arr.astype(_acc_dtype(arr.dtype, op), copy=True)
+        chunks = np.array_split(buf, n, axis=0)
+        uf = _UFUNCS[acc_op]
         nxt = (self.rank + 1) % n
         for step in range(n - 1):
             send_idx = (self.rank - step) % n
             recv_idx = (self.rank - step - 1) % n
             fut = self._send_async(nxt, (seq, "rs", step), chunks[send_idx])
-            arr, holder = self._materialize(self._recv((seq, "rs", step)))
-            chunks[recv_idx] = _REDUCE_OPS[acc_op]([chunks[recv_idx], arr])
+            arr_in, holder = self._materialize(self._recv((seq, "rs", step)))
+            uf(chunks[recv_idx], arr_in, out=chunks[recv_idx])
             self._finish_consume(holder)
             if fut is not None:
-                fut.result(timeout=120.0)
+                fut.result(timeout=self._timeout)
         owned = (self.rank + 1) % n
         res = chunks[owned]
-        if mean:
-            res = res / n
+        if op == "mean":
+            np.divide(res, n, out=res)
         # Rotate so the API's slots[rank] convention holds: ring ownership
-        # is chunk (rank+1)%n; the contract gives rank its OWN index.
-        self._send((self.rank + 1) % n, (seq, "rsrot", 0), res)
-        arr, holder = self._materialize(self._recv((seq, "rsrot", 0)))
+        # is chunk (rank+1)%n; the contract gives rank its OWN index. The
+        # rotation rides the async path (big chunks cross by shm key); the
+        # received chunk is copied ONLY when an shm holder is attached — a
+        # socket-delivered chunk is already private.
+        fut = self._send_async((self.rank + 1) % n, (seq, "rsrot", 0), res)
+        out, holder = self._materialize(self._recv((seq, "rsrot", 0)))
         if holder is not None:
-            arr = np.array(arr)  # returned to the caller: detach from shm
+            out = np.array(out)  # returned to the caller: detach from shm
             self._finish_consume(holder)
-        return arr
+        if fut is not None:
+            fut.result(timeout=self._timeout)
+        return out
 
     def _allgather(self, seq: int, value) -> List[np.ndarray]:
         n = self.world_size
@@ -649,7 +1125,7 @@ class _DistributedGroup:
                 self._finish_consume(holder)
             out[carry_idx] = arr
             if fut is not None:
-                fut.result(timeout=120.0)
+                fut.result(timeout=self._timeout)
         return out  # type: ignore[return-value]
 
     def _broadcast(self, seq: int, value, src: int):
@@ -695,7 +1171,7 @@ class _DistributedGroup:
                     (src + child_rel) % n, (seq, "bc", child_rel), arr))
         for fut in futs:
             if fut is not None:
-                fut.result(timeout=120.0)
+                fut.result(timeout=self._timeout)
         if holder is not None:
             arr = np.array(arr)  # result is returned to the caller
             self._finish_consume(holder)
@@ -724,7 +1200,7 @@ class _DistributedGroup:
             self._finish_consume(h)
         for fut in futs:
             if fut is not None:
-                fut.result(timeout=120.0)
+                fut.result(timeout=self._timeout)
         return result
 
     # -- p2p ----------------------------------------------------------------
@@ -734,7 +1210,7 @@ class _DistributedGroup:
                          self._p2p_counter(src, dst, "send")), value)
 
     def p2p_recv(self, src: int, dst: int,
-                 timeout: Optional[float] = 60.0):
+                 timeout: Optional[float] = None):
         # Matching monotone counters on both ends keep repeated send/recv
         # pairs FIFO-ordered. The cursor is RESERVED under the lock before
         # blocking — two concurrent recvs for the same (src, dst) get
@@ -743,6 +1219,8 @@ class _DistributedGroup:
         # its reservation back (only if it is still the newest — with a
         # later recv outstanding the gap is unrecoverable either way) so a
         # single-threaded retry consumes the late-arriving message.
+        if timeout is None:
+            timeout = self._timeout
         key = ("p2p_ctr", src, dst, "recv")
         with self._op_lock:
             d = getattr(self, "_p2p_counts", None)
@@ -853,10 +1331,11 @@ def init_collective_group(
 
 def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None:
     """Cross-process backend: every rank hosts a member mailbox server and
-    publishes its address through the control plane's KV (exactly how the
+    publishes its address — AND its node-store name + hierarchy vote, the
+    topology rendezvous — through the control plane's KV (exactly how the
     reference exchanges the NCCL unique id — nccl_collective_group.py via
-    the internal KV); collectives then run rank-to-rank over a ring /
-    binomial tree with no hub."""
+    the internal KV); collectives then run rank-to-rank over the two-level
+    or flat schedule with no hub."""
     import time as _time
 
     from ray_tpu.core.rpc import RpcServer
@@ -870,6 +1349,7 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
 
     import os as _os
 
+    cfg = _get_config()
     gcs = get_runtime().gcs
     service = _MemberService()
     # Open the node store (and arm the service's shm surface) BEFORE the
@@ -889,13 +1369,16 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
             my_store = ""
     server = RpcServer(service, name=f"collective-{group_name}-r{rank}",
                        max_workers=max(8, world_size + 2))
+    my_hier = "1" if cfg.collective_hierarchy_enabled else "0"
     gcs.kv_put(f"collective:{group_name}:addr:{rank}",
-               f"{server.address}|{my_store}".encode(),
+               f"{server.address}|{my_store}|{my_hier}".encode(),
                namespace="collective")
     addrs: List[Optional[str]] = [None] * world_size
     stores: List[Optional[str]] = [None] * world_size
+    hier_votes: List[bool] = [True] * world_size
     addrs[rank] = server.address
     stores[rank] = my_store or None
+    hier_votes[rank] = my_hier == "1"
     deadline = _time.time() + 60.0
     while any(a is None for a in addrs):
         for r in range(world_size):
@@ -903,10 +1386,10 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
                 raw = gcs.kv_get(f"collective:{group_name}:addr:{r}",
                                  namespace="collective")
                 if raw:
-                    text = raw.decode()
-                    addr, _, store = text.partition("|")
-                    addrs[r] = addr
-                    stores[r] = store or None
+                    parts = raw.decode().split("|")
+                    addrs[r] = parts[0]
+                    stores[r] = (parts[1] or None) if len(parts) > 1 else None
+                    hier_votes[r] = parts[2] != "0" if len(parts) > 2 else True
         if any(a is None for a in addrs):
             if _time.time() > deadline:
                 server.stop()
@@ -915,8 +1398,10 @@ def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None
                     f"collective group {group_name}: ranks {missing} never "
                     f"published their member address")
             _time.sleep(0.05)
+    # The schedule must be identical on every rank (tags would never pair
+    # up otherwise): the hierarchy runs only when EVERY member voted for it.
     group = _DistributedGroup(world_size, rank, addrs, service, server,
-                              stores=stores)
+                              stores=stores, hierarchy=all(hier_votes))
     group._kv_key = f"collective:{group_name}:addr:{rank}"
     with _groups_lock:
         _groups[group_name] = group  # type: ignore[assignment]
@@ -954,6 +1439,16 @@ def get_rank(group_name: str = "default") -> int:
 def get_collective_group_size(group_name: str = "default") -> int:
     state = _group(group_name)
     return state.world_size
+
+
+def get_group_stats(group_name: str = "default") -> Dict[str, int]:
+    """Instrumentation snapshot for a cross-process group: logical payload
+    bytes sent split by same-store vs cross-store destination (the
+    DCN-analog traffic the hierarchy minimizes) and how many reduction
+    rounds took each schedule. Empty for in-process backends."""
+    state = _group(group_name)
+    st = getattr(state, "stats", None)
+    return dict(st) if st else {}
 
 
 def _group(group_name: str) -> _GroupState:
@@ -1034,8 +1529,10 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     state.p2p_send(rank, dst_rank, _to_numpy(tensor))
 
 
-def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
-    """reference: collective.py:594 (p2p)."""
+def recv(src_rank: int, group_name: str = "default",
+         timeout: Optional[float] = None):
+    """reference: collective.py:594 (p2p). ``timeout=None`` uses the
+    group's ``collective_timeout_s``."""
     state = _group(group_name)
     rank = get_rank(group_name)
     return state.p2p_recv(src_rank, rank, timeout)

@@ -21,7 +21,7 @@ possibly spanning DCN between slices — the scaling-book recipe.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -30,6 +30,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Canonical axis order, outermost → innermost (DCN-tolerant → ICI-hungry).
 AXIS_ORDER = ("data", "fsdp", "expert", "pipe", "seq", "tensor")
+
+# Fabric tier of each canonical axis: ``data``/``fsdp`` collectives are
+# bandwidth-bound and overlappable, so those axes may span the slow
+# inter-slice DCN; every inner axis demands single-slice ICI latency. The
+# eager host collectives mirror this two-level split at the process level
+# (``ray_tpu.parallel.collectives``: intra-node shm tier + inter-node ring).
+AXIS_TIER = {"data": "dcn", "fsdp": "dcn", "expert": "ici", "pipe": "ici",
+             "seq": "ici", "tensor": "ici"}
 
 
 @dataclass(frozen=True)
@@ -133,6 +141,18 @@ def mesh_shape(mesh: Mesh) -> Dict[str, int]:
 def dp_axes(mesh: Mesh) -> tuple:
     """Axes over which gradients are reduced (data + fsdp)."""
     return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+def hierarchy_split(mesh: Mesh) -> Tuple[tuple, tuple]:
+    """(dcn_axes, ici_axes) among the mesh's ACTIVE (size>1) axes.
+
+    The compiled-path statement of the same two-level schedule the eager
+    collectives run on hosts: reduce over the ICI axes first (fast, inside
+    a slice), cross the DCN tier once with the already-reduced partials.
+    """
+    active = [a for a, s in mesh_shape(mesh).items() if s > 1]
+    return (tuple(a for a in active if AXIS_TIER.get(a) == "dcn"),
+            tuple(a for a in active if AXIS_TIER.get(a) != "dcn"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

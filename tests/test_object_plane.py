@@ -429,3 +429,65 @@ def test_in_process_fetch_args_concurrent_and_ordered():
                         timeout=60)
     finally:
         ray_tpu.shutdown()
+
+
+# ====================== pubsub filters / per-oid wait lists ======================
+
+
+def test_subscribe_object_locations_server_side_filter():
+    """The GCS-side subscription filter: only the subscribed oids come
+    back, and the cursor advances past filtered misses so they are never
+    rescanned."""
+    from ray_tpu.core.gcs_server import GcsService
+
+    svc = GcsService()
+    try:
+        a, b = b"a" * 28, b"b" * 28
+        svc._publish("object_locations", (a, None, "addr1", 1))
+        svc._publish("object_locations", (b, None, "addr2", 2))
+        end, msgs = svc.subscribe_object_locations(0, 1.0, [a])
+        assert end == 2 and [m[0] for m in msgs] == [a]
+        # Filter matches nothing: empty reply, cursor consumed the misses.
+        cur, msgs = svc.subscribe_object_locations(0, 0.1, [b"x" * 28])
+        assert msgs == [] and cur == 2
+        # Unfiltered subscribe keeps the firehose contract.
+        end, msgs = svc.subscribe_object_locations(0, 1.0)
+        assert [m[0] for m in msgs] == [a, b]
+    finally:
+        svc.shutdown()
+
+
+def test_subscribe_object_locations_per_oid_wait_lists():
+    """A parked filtered subscribe wakes ONLY when one of ITS oids seals:
+    seals of other objects (which used to wake every parked poll on one
+    condvar) leave it asleep, and generic channel polls park per channel."""
+    import threading
+
+    from ray_tpu.core.gcs_server import GcsService
+
+    svc = GcsService()
+    try:
+        target = b"c" * 28
+        done = {}
+
+        def park():
+            done["r"] = svc.subscribe_object_locations(0, 10.0, [target])
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        for i in range(5):  # unrelated seals: the parked poll must not wake
+            svc._publish("object_locations", (bytes([i]) * 28, None, "n", 1))
+        svc._publish("node", ("ALIVE", "beef", "addr"))  # other channel too
+        time.sleep(0.3)
+        assert "r" not in done
+        t0 = time.monotonic()
+        svc._publish("object_locations", (target, None, "addr3", 3))
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert time.monotonic() - t0 < 2.0
+        cursor, msgs = done["r"]
+        assert [m[0] for m in msgs] == [target]
+        assert cursor == 6  # advanced past every filtered miss
+    finally:
+        svc.shutdown()
