@@ -7,6 +7,20 @@ parallelism axis (DP/TP/PP/SP/EP), XLA collectives over ICI/DCN instead of
 NCCL, and Pallas kernels for the hot ops.
 """
 
+import os as _os
+
+if _os.environ.get("RAY_TPU_LOCK_ORDER_CHECK_ENABLED", "").lower() in (
+        "1", "true", "yes", "on"):
+    # Instrument threading BEFORE the submodule imports below create the
+    # package's module-level locks (config._lock, runtime._init_lock,
+    # collectives._groups_lock, ...) — installing any later leaves those
+    # permanently invisible to the runtime lock-order validator. devtools
+    # imports nothing back from ray_tpu, so this is cycle-safe; when the
+    # knob is off (the default) devtools is never imported at all.
+    from ray_tpu.devtools import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
 from ray_tpu._version import version as __version__
 from ray_tpu.api import (
     available_resources,

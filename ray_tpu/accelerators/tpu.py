@@ -29,6 +29,17 @@ _TPU_NAME_ENV = "TPU_NAME"
 _DEFAULT_CHIPS_PER_HOST = 4
 
 
+def _chips_per_host_default() -> int:
+    """The tpu_chips_per_host knob, falling back to the classic 4/host
+    when the config table isn't importable yet (early startup)."""
+    try:
+        from ray_tpu.core.config import config
+
+        return config().tpu_chips_per_host
+    except Exception:  # noqa: BLE001 — mirror the flag's default
+        return _DEFAULT_CHIPS_PER_HOST
+
+
 @dataclass(frozen=True)
 class TpuInfo:
     chips_on_host: int
@@ -73,8 +84,10 @@ def detect_tpu() -> Optional[TpuInfo]:
             m = re.search(r"v(\d+[a-z]*)", str(tpus[0].device_kind).lower())
             if m:
                 generation = "V" + m.group(1).upper()
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 — no jax/TPU: env detection below
+        from ray_tpu.utils.logging import get_logger, log_swallowed
+
+        log_swallowed(get_logger("accelerators"), "jax TPU probe")
 
     acc_type = os.environ.get(_GKE_TPU_ACCELERATOR_ENV)
     if chips == 0:
@@ -82,7 +95,7 @@ def detect_tpu() -> Optional[TpuInfo]:
         if visible:
             chips = len([c for c in visible.split(",") if c.strip()])
         elif acc_type:
-            chips = _DEFAULT_CHIPS_PER_HOST
+            chips = _chips_per_host_default()
     if chips == 0:
         return None
 

@@ -36,7 +36,15 @@ class _Flag:
             if isinstance(value, str):
                 return value.lower() in ("1", "true", "yes", "on")
             return bool(value)
-        return self.type(value)
+        try:
+            return self.type(value)
+        except (TypeError, ValueError) as e:
+            name = self.name or "<unbound>"
+            raise ValueError(
+                f"invalid value {value!r} for config flag '{name}': expected "
+                f"{self.type.__name__} (set via the RAY_TPU_{name.upper()} "
+                f"env var or the system_config dict passed to init())"
+            ) from e
 
 
 class Config:
@@ -76,7 +84,9 @@ class Config:
     # shm arena and registers this node as a new replica — so broadcasts
     # fan out across nodes instead of serializing on the origin daemon.
     whole_frame_fetch_max = _Flag(1 * 1024 * 1024)
+    # Chunks of one pull in flight at once (the transfer pipeline depth).
     pull_chunk_concurrency = _Flag(4)
+    # Total bytes of in-flight pulled chunks across all concurrent pulls.
     pull_memory_budget = _Flag(512 * 1024 * 1024)
     # Batched get(): max refs fetched concurrently by one get([refs]) call
     # (the bounded fan-out of the parallel read path; total in-flight pull
@@ -117,6 +127,7 @@ class Config:
     # Node memory-usage fraction above which the daemon kills the newest
     # busy TASK worker (retriable-FIFO policy). >=1.0 disables.
     memory_monitor_threshold = _Flag(0.95)
+    # Seconds between memory-monitor sweeps.
     memory_monitor_period_s = _Flag(1.0)
 
     # -- health / fault tolerance --------------------------------------------
@@ -124,6 +135,7 @@ class Config:
     # ray_config_def.h:841-847 health_check_{initial_delay,period,timeout}_ms,
     # health_check_failure_threshold).
     health_check_period_s = _Flag(1.0)
+    # Missed heartbeats before a node is declared dead.
     health_check_failure_threshold = _Flag(5)
     # Default task retries (reference: task max_retries default 3).
     default_max_retries = _Flag(3)
@@ -133,8 +145,15 @@ class Config:
     streaming_backpressure_items = _Flag(64)
 
     # -- timeouts -------------------------------------------------------------
+    # TCP connect timeout for every RpcClient (control-plane dials).
     rpc_connect_timeout_s = _Flag(10.0)
+    # An untimed get() logs a warning after waiting this long for a seal.
     get_timeout_warn_s = _Flag(30.0)
+    # Wait slice for internal Condition/Event waits that re-check their
+    # predicate in a loop (actor mailboxes, generator item waits, batcher
+    # flush waits): a lost peer wakes the thread at this cadence instead of
+    # parking it forever on a condition nobody will ever signal.
+    internal_wait_timeout_s = _Flag(60.0)
 
     # -- RPC fast path --------------------------------------------------------
     # Adaptive frame-coalescing window in MICROSECONDS: a non-urgent lone
@@ -173,6 +192,14 @@ class Config:
     # recv, p2p recv without an explicit timeout). Short-lived jobs and
     # tests lower this to fail fast on a lost rank.
     collective_timeout_s = _Flag(120.0)
+
+    # -- debugging ------------------------------------------------------------
+    # Opt-in runtime lock-order validator (ray_tpu.devtools.lockcheck):
+    # threading.Lock/RLock/Condition are replaced with instrumented wrappers
+    # that track per-thread held-sets, maintain a global acquisition-order
+    # graph, and raise LockOrderError on an inversion. Dev/test only — adds
+    # per-acquire bookkeeping to every lock in the process.
+    lock_order_check_enabled = _Flag(False)
 
     # -- TPU ------------------------------------------------------------------
     # Logical chips per host for resource autodetection when no TPU present

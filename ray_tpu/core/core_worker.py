@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -40,7 +41,7 @@ from ray_tpu.core.rpc import (RpcClient, RpcClientPool, RpcConnectionError,
                               RpcRemoteError)
 from ray_tpu.core.task_spec import (SpecCacheMiss, SpecEncoder, TaskSpec,
                                     TaskType, spec_var_fields)
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("core_worker")
 
@@ -814,6 +815,19 @@ class CoreWorker:
         self._free_batch: List[bytes] = []
         self._free_flusher = None
 
+        # __del__-deferred releases (see release_local_ref): a finalizer
+        # can run at ANY decref point — including while this thread holds
+        # _cache_lock (a cache pop decrefs a value whose contained refs
+        # finalize right there) or an RPC client's state lock — so
+        # finalizers must not acquire locks or send. They append to this
+        # deque (atomic, lock-free under the GIL); the drainer thread does
+        # the real refcount work with no locks held.
+        self._ref_releases: deque = deque()
+        self._ref_release_stop = threading.Event()
+        self._ref_release_thread = threading.Thread(
+            target=self._ref_release_loop, name="ref-release", daemon=True)
+        self._ref_release_thread.start()
+
         # Owner service: inline-small objects are served from this process's
         # cache instead of being sealed through the node daemon (ownership-
         # based directory; see _OwnerService).
@@ -939,7 +953,7 @@ class CoreWorker:
                                      self.current_node_id, size, lineage)
                 return
             except Exception:  # noqa: BLE001 — arena full
-                pass
+                log_swallowed(logger, "shm put of owned object")
         if size > cfg.pull_chunk_size:
             # Too big for the arena (or no arena): chunked upload straight
             # to the daemon's spill shelf — neither side holds a second
@@ -1040,6 +1054,46 @@ class CoreWorker:
                         strikes.pop(addr, None)
                         self._owner_clients.invalidate(addr)
                         self.reference_counter.purge_borrower_addr(addr)
+
+    def release_local_ref(self, oid: ObjectID) -> None:
+        """GC-context entry point (``ObjectRef.__del__``): defer the
+        refcount drop to the drainer thread. Finalizers run at arbitrary
+        decref points — possibly with _cache_lock or an RPC client's state
+        lock held on this very thread — so doing the free work (which takes
+        _cache_lock and may send deregistration RPCs) inline is a lock-order
+        inversion the runtime validator flags. deque.append is atomic."""
+        self._ref_releases.append(("ref", oid))
+
+    def release_generator_deferred(self, task_id: TaskID) -> None:
+        """GC-context entry point (``ObjectRefGenerator.__del__``); same
+        contract as release_local_ref — release_generator takes
+        _cache_lock, which may already be held at the finalizer's site."""
+        self._ref_releases.append(("gen", task_id))
+
+    def _ref_release_loop(self) -> None:
+        """Drainer for __del__-deferred releases: runs the real refcount
+        work lock-free-context (this thread holds nothing across calls).
+        Deferral only delays decrements, so counts are transiently high —
+        never low: no premature frees, and the borrow tests' _drained()
+        polls absorb the ~20ms cadence."""
+        q = self._ref_releases
+
+        def drain() -> None:
+            while q:
+                kind, arg = q.popleft()
+                try:
+                    if kind == "ref":
+                        self.reference_counter.remove_local_reference(arg)
+                    else:
+                        self.release_generator(arg)
+                except Exception:  # noqa: BLE001 — release is best-effort
+                    log_swallowed(logger, "deferred ref release")
+
+        while True:
+            drain()
+            if self._ref_release_stop.wait(timeout=0.02):
+                drain()  # entries queued during the final wait
+                return
 
     def _free_object(self, oid: ObjectID) -> None:
         """Owner-side free: drop the local value now, batch the cluster-wide
@@ -1376,7 +1430,7 @@ class CoreWorker:
             self._get_one(ref, time.time() + 300.0, notify_blocked=False,
                           is_prefetch=True)
         except BaseException:  # noqa: BLE001 — advisory; the real arg
-            pass               # fetch surfaces any error
+            log_swallowed(logger, "prefetch fetch")  # fetch surfaces errors
         finally:
             self._finish_prefetch(ref.id)
 
@@ -1408,6 +1462,7 @@ class CoreWorker:
         missing_since: float | None = None
         recovered = False
         started = time.time()
+        warn_after = config().get_timeout_warn_s
         last_locate = 0.0
         notified_blocked = not notify_blocked
         owner_hint = getattr(ref, "_owner_hint", None)
@@ -1424,6 +1479,11 @@ class CoreWorker:
                 if cancel_event is not None and cancel_event.is_set():
                     raise GetTimeoutError(
                         f"get() abandoned on {oid.hex()[:12]}")
+                if warn_after and time.time() - started > warn_after:
+                    logger.warning(
+                        "get() on %s still waiting after %.0fs",
+                        oid.hex()[:12], warn_after)
+                    warn_after = 0.0
                 if (not notified_blocked
                         and self.blocked_on_get is not None
                         and time.time() - started > 0.05):
@@ -2147,6 +2207,8 @@ class CoreWorker:
                                 remaining = deadline - time.time()
                                 if remaining <= 0:
                                     break
+                                # raylint: ignore[blocking-under-lock]
+                                # — state.cv wraps _key_lock (see _KeyState)
                                 state.cv.wait(remaining)
                         finally:
                             state.waiters -= 1
@@ -2712,6 +2774,8 @@ class CoreWorker:
         import heapq
 
         try:
+            # raylint: ignore[untimed-wait] — completion callback: fut
+            # is already resolved when this runs
             result = fut.result()
         except RpcConnectionError:
             with st["lock"]:
@@ -2738,7 +2802,8 @@ class CoreWorker:
                                 self._actor_clients.get(addr) \
                                     .forget_template(call.digest)
                             except Exception:  # noqa: BLE001
-                                pass
+                                log_swallowed(logger,
+                                              "forget_template on miss")
                         heapq.heappush(st["heap"], (seq, call))
                         ent = None
                     self._pump_actor_queue(key, st)
@@ -3042,7 +3107,7 @@ class CoreWorker:
                             try:
                                 sink(entry, line)
                             except Exception:  # noqa: BLE001
-                                pass
+                                log_swallowed(logger, "log-mirror sink")
             client.close()
 
         self._log_thread = threading.Thread(
@@ -3053,6 +3118,10 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        # Flush __del__-deferred releases while the owner/GCS connections
+        # are still open (deregistrations and frees ride RPCs).
+        self._ref_release_stop.set()
+        self._ref_release_thread.join(timeout=2.0)
         # Wake hot-idle runners and let them hand their leased workers back
         # while the daemon connections are still open — otherwise the
         # daemons' conn-close reclaim KILLS those workers (they might be

@@ -43,7 +43,7 @@ from ray_tpu.core.task_spec import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("gcs_server")
 
@@ -1031,7 +1031,7 @@ class GcsService:
                 self._daemons.get(addr).notify(
                     "store_gcs_snapshot", self._snapshot_seq, data)
             except Exception:  # noqa: BLE001 — mirror is best-effort
-                pass
+                log_swallowed(logger, "snapshot mirror push")
 
     def _restore_from_mirror(self, daemon_addr: str) -> None:
         from ray_tpu.core.rpc import RpcClient
@@ -1151,8 +1151,8 @@ class GcsService:
         self._stopped.set()
         try:
             self._snapshot()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            log_swallowed(logger, "final snapshot at shutdown")
 
 
 def serve(port: int = 0, host: str = "127.0.0.1",
@@ -1166,6 +1166,9 @@ def serve(port: int = 0, host: str = "127.0.0.1",
 
 
 def main(argv=None) -> int:
+    from ray_tpu.devtools.lockcheck import maybe_install
+
+    maybe_install()  # lock_order_check_enabled: instrument before any locks
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
@@ -1187,7 +1190,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
-    stop.wait()
+    while not stop.wait(timeout=60.0):
+        pass  # timed slices: signal handlers still interrupt immediately
     server.stop()
     return 0
 

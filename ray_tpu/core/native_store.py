@@ -17,6 +17,10 @@ import os
 import subprocess
 from typing import Optional
 
+from ray_tpu.utils.logging import get_logger, log_swallowed
+
+logger = get_logger("native_store")
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libray_tpu_store.so")
 
@@ -96,8 +100,8 @@ class _Pin:
     def __del__(self):
         try:
             self._store.release(self._oid)
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            log_swallowed(logger, "shm view release")
 
 
 class NativeObjectStore:
@@ -259,5 +263,5 @@ class NativeObjectStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            log_swallowed(logger, "native store close")

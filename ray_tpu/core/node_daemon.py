@@ -38,7 +38,7 @@ from ray_tpu.core.rpc import (
     RpcConnectionError,
     RpcServer,
 )
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("node_daemon")
 
@@ -254,7 +254,7 @@ class NodeDaemon:
             try:
                 self._shm.destroy()
             except Exception:  # noqa: BLE001
-                pass
+                log_swallowed(logger, "shm store destroy at shutdown")
         import shutil
 
         shutil.rmtree(self._log_dir, ignore_errors=True)
@@ -1429,11 +1429,14 @@ class NodeDaemon:
             me = psutil.Process(os.getpid())
             out["daemon_rss"] = me.memory_info().rss
         except Exception:  # noqa: BLE001 — psutil optional
-            pass
+            log_swallowed(logger, "psutil node stats")
         return out
 
 
 def main(argv=None) -> int:
+    from ray_tpu.devtools.lockcheck import maybe_install
+
+    maybe_install()  # lock_order_check_enabled: instrument before any locks
     import faulthandler
 
     try:
@@ -1463,7 +1466,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
-    stop.wait()
+    while not stop.wait(timeout=60.0):
+        pass  # timed slices: signal handlers still interrupt immediately
     return 0
 
 

@@ -12,9 +12,12 @@ from __future__ import annotations
 from typing import TYPE_CHECKING
 
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 if TYPE_CHECKING:
     pass
+
+logger = get_logger("object_ref")
 
 
 def _runtime():
@@ -77,12 +80,16 @@ class ObjectRef:
         return (ObjectRef, (self._id, self._owner_hint))
 
     def __del__(self):
+        # Finalizers run at arbitrary decref points — possibly while this
+        # thread holds runtime locks — so the release must not take locks
+        # here: release_local_ref defers to a drainer in multiprocess mode
+        # (CoreWorker) and stays synchronous in-process (Runtime).
         try:
             rt = _maybe_runtime()
             if rt is not None:
-                rt.reference_counter.remove_local_reference(self._id)
-        except Exception:
-            pass  # interpreter shutdown
+                rt.release_local_ref(self._id)
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            log_swallowed(logger, "ref release")
 
 
 def _maybe_runtime():
@@ -132,7 +139,11 @@ class ObjectRefGenerator:
     def __del__(self):
         # Reclaim owner-side stream state + never-consumed inline items
         # (they were registered owned at report time and have no handles).
+        # Deferred in multiprocess mode: release_generator takes runtime
+        # locks a finalizer's interrupted thread may already hold.
         try:
-            self._runtime.release_generator(self._task_id)
+            release = getattr(self._runtime, "release_generator_deferred",
+                              None)
+            (release or self._runtime.release_generator)(self._task_id)
         except Exception:  # noqa: BLE001 — interpreter teardown
-            pass
+            log_swallowed(logger, "release_generator")

@@ -21,7 +21,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject, deserialize, serialize
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("object_store")
 
@@ -275,8 +275,8 @@ class MemoryStore:
         if self._native is not None:
             try:
                 self._native.destroy()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log_swallowed(logger, "native segment destroy")
             self._native = None
 
     def stats(self) -> dict:

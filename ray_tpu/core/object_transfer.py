@@ -21,7 +21,7 @@ from typing import Optional
 
 from ray_tpu.core.config import config
 from ray_tpu.core.rpc import RpcClient
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("object_transfer")
 
@@ -197,7 +197,7 @@ class PullManager:
             try:
                 client.release_dests([f for _, _, f in inflight])
             except Exception:  # noqa: BLE001 — connection already torn down
-                pass
+                log_swallowed(logger, "release_dests on dead connection")
         with st["cv"]:
             for off, length, _f in inflight:
                 st["queue"].append((off, length))
@@ -248,5 +248,5 @@ class PushManager:
             try:
                 client.notify("abort_spill_put", key)
             except Exception:  # noqa: BLE001 — daemon gone; its sweeper
-                pass  # cleans the partial file
+                log_swallowed(logger, "abort_spill_put")  # sweeps partials
             return False

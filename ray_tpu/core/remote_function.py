@@ -13,6 +13,7 @@ import hashlib
 import uuid
 from typing import Any, Dict
 
+from ray_tpu.core.config import config
 from ray_tpu.core.ids import TaskID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import get_runtime
@@ -50,7 +51,7 @@ def resolve_options(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Task
         name=merged.get("name") or "",
         num_returns=merged.get("num_returns", 1),
         resources=resources,
-        max_retries=merged.get("max_retries", 3),
+        max_retries=merged.get("max_retries", config().default_max_retries),
         retry_exceptions=merged.get("retry_exceptions", False),
         max_restarts=merged.get("max_restarts", 0),
         max_task_retries=merged.get("max_task_retries", 0),

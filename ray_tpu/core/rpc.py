@@ -240,6 +240,17 @@ def _sendmsg_all(sock: socket.socket, iovecs: list) -> None:
             i += 1
 
 
+def _connect_timeout_default() -> float:
+    """The rpc_connect_timeout_s knob, with the config-table default as the
+    fallback when the config machinery is unavailable (mid-teardown)."""
+    try:
+        from ray_tpu.core.config import config
+
+        return config().rpc_connect_timeout_s
+    except Exception:  # noqa: BLE001 — mirror the flag's default exactly
+        return 10.0
+
+
 def _rpc_tunables() -> tuple:
     """(window_s, max_batch_frames, max_batch_bytes) from the config table
     (env-overridable as RAY_TPU_RPC_COALESCE_WINDOW_US etc.)."""
@@ -806,12 +817,14 @@ class RpcClient:
     as core-worker transports do in the reference).
     """
 
-    def __init__(self, address: str, connect_timeout: float = 10.0,
+    def __init__(self, address: str, connect_timeout: Optional[float] = None,
                  auth_token: Optional[bytes] = None):
         import uuid
 
         self.address = address
-        self._timeout = connect_timeout
+        # None -> the rpc_connect_timeout_s config knob (10s default).
+        self._timeout = (_connect_timeout_default() if connect_timeout is None
+                         else connect_timeout)
         self._token = _auth_token() if auth_token is None else auth_token
         # Stable across reconnects: servers key liveness-scoped state
         # (leases, leased workers) on this, not on TCP connections.
@@ -837,14 +850,18 @@ class RpcClient:
                 raise RpcConnectionError("client closed")
             if self._sock is not None:
                 return self._sock
-            host, port = self.address.rsplit(":", 1)
-            try:
-                sock = socket.create_connection((host, int(port)),
-                                                timeout=self._timeout)
-            except OSError as e:
-                raise RpcConnectionError(
-                    f"cannot connect to {self.address}: {e}"
-                ) from e
+        # Dial + handshake OUTSIDE the state lock: a slow connect (dead
+        # peer, SYN backlog) must not block unrelated senders/flushes on
+        # this client for the whole connect timeout.
+        host, port = self.address.rsplit(":", 1)
+        try:
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=self._timeout)
+        except OSError as e:
+            raise RpcConnectionError(
+                f"cannot connect to {self.address}: {e}"
+            ) from e
+        try:
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             token = self._token
@@ -862,6 +879,24 @@ class RpcClient:
             except OSError as e:
                 raise RpcConnectionError(
                     f"hello to {self.address} failed: {e}") from e
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        with self._state_lock:
+            if self._closed or self._sock is not None:
+                # Lost the connect race (or the client closed meanwhile):
+                # discard ours — the server saw hello open+close, which the
+                # death-grace counting tolerates.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if self._closed:
+                    raise RpcConnectionError("client closed")
+                return self._sock
             self._sock = sock
             self._sender = _FrameSender(sock, on_error=self._on_send_error)
             self._sent_templates = set()
@@ -1064,7 +1099,7 @@ class RpcClientPool:
     """Cached clients keyed by address (reference: client pools in
     ``src/ray/rpc/*_client_pool.h``)."""
 
-    def __init__(self, connect_timeout: float = 10.0):
+    def __init__(self, connect_timeout: Optional[float] = None):
         self._timeout = connect_timeout
         self._clients: Dict[str, RpcClient] = {}
         self._lock = threading.Lock()

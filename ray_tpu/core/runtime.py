@@ -60,7 +60,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
     TaskType,
 )
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("runtime")
 
@@ -258,7 +258,10 @@ class ActorRunner:
         while True:
             with self.lock:
                 while not self.mailbox and not self.dead:
-                    self.cv.wait()
+                    # Timed slice: a runner parked on a dead mailbox wakes
+                    # to re-check instead of sleeping forever on a condition
+                    # nobody will signal again.
+                    self.cv.wait(timeout=config().internal_wait_timeout_s)
                 if self.dead:
                     return
                 state = self.mailbox.popleft()
@@ -398,8 +401,8 @@ class Runtime:
             from ray_tpu.accelerators import tpu_resources
 
             resources.update(tpu_resources())
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — detection is best-effort
+            log_swallowed(logger, "TPU resource autodetect")
 
     def add_node(
         self, resources: Dict[str, float], labels: Dict[str, str] | None = None
@@ -900,7 +903,8 @@ class Runtime:
             return None
         with state.generator_cv:
             while len(state.generator_items) <= index and not state.generator_done:
-                state.generator_cv.wait()
+                state.generator_cv.wait(
+                    timeout=config().internal_wait_timeout_s)
             if index < len(state.generator_items):
                 return ObjectRef(state.generator_items[index])
             return None
@@ -915,6 +919,12 @@ class Runtime:
         """In-process runtime keeps generator items in the task record, which
         the task table already reclaims; nothing extra to free here (the
         CoreWorker counterpart collects owner-cache stream state)."""
+
+    def release_local_ref(self, oid: ObjectID) -> None:
+        """``ObjectRef.__del__`` entry point. In-process the release is
+        synchronous (the store's free path holds no lock across other
+        acquisitions); the CoreWorker counterpart defers to a drainer."""
+        self.reference_counter.remove_local_reference(oid)
 
     # -- actors (core_worker.cc:2139 CreateActor, :2377 SubmitActorTask) ------
 
@@ -1201,14 +1211,14 @@ class Runtime:
         for actor_id in list(self.actors):
             try:
                 self.kill_actor(actor_id)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                log_swallowed(logger, "kill_actor at shutdown")
         self.gcs.finish_job(self.job_id)
         self._arg_pool.shutdown(wait=False, cancel_futures=True)
         try:
             self.store.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            log_swallowed(logger, "object store close")
 
 
 def _resolve_actor_method(instance, method_name: str):

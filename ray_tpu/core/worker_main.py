@@ -32,7 +32,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.core.task_spec import (DAG_LOOP_METHOD, SpecTemplateStore,
                                     TaskSpec)
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("worker")
 
@@ -88,7 +88,7 @@ class _TaskEventBuffer:
             try:
                 self._gcs.notify("record_task_events", batch)
             except Exception:  # noqa: BLE001 — tracing never breaks work
-                pass
+                log_swallowed(logger, "task-event flush")
 
 
 class _ActorState:
@@ -753,7 +753,7 @@ def _die_with_parent() -> None:
         PR_SET_PDEATHSIG = 1
         libc.prctl(PR_SET_PDEATHSIG, _signal.SIGKILL)
     except Exception:  # noqa: BLE001 — non-Linux: watchdog still covers it
-        pass
+        log_swallowed(logger, "prctl PDEATHSIG setup")
 
 
 def _install_stack_dumper() -> None:
@@ -769,6 +769,9 @@ def _install_stack_dumper() -> None:
 
 
 def main() -> int:
+    from ray_tpu.devtools.lockcheck import maybe_install
+
+    maybe_install()  # lock_order_check_enabled: instrument before any locks
     _die_with_parent()
     _install_stack_dumper()
     if os.environ.get("RAY_TPU_PROFILE_WORKER"):

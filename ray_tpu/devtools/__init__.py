@@ -1,0 +1,7 @@
+"""Developer tooling: static analysis (`python -m ray_tpu.devtools.lint`)
+and the opt-in runtime lock-order validator (`ray_tpu.devtools.lockcheck`).
+
+Nothing in this package is imported by the runtime unless explicitly
+enabled (the `lock_order_check_enabled` config knob) — shipping code pays
+zero cost for it.
+"""

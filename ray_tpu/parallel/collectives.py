@@ -37,7 +37,7 @@ import numpy as np
 
 from ray_tpu.core.config import config as _get_config
 from ray_tpu.core.runtime import get_runtime
-from ray_tpu.utils.logging import get_logger
+from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("collectives")
 
@@ -364,7 +364,7 @@ class _ShmIncoming:
         try:
             self._shm.release(self.key)
         except Exception:  # noqa: BLE001 — store gone at shutdown
-            pass
+            log_swallowed(logger, "shm release at close")
 
 
 _TAKE_DEFAULT = object()  # sentinel: "use the service's configured timeout"
@@ -427,7 +427,7 @@ class _MemberService:
             try:
                 self.shm.delete(key)
             except Exception:  # noqa: BLE001 — store gone at shutdown
-                pass
+                log_swallowed(logger, "shm delete after acks")
 
     def take(self, tag: tuple, timeout=_TAKE_DEFAULT):
         import time as _time
@@ -657,7 +657,7 @@ class _DistributedGroup:
             self._peers.get(self._addrs[incoming.origin]).notify(
                 "shm_done", incoming.key)
         except Exception:  # noqa: BLE001 — origin gone; its store reaps
-            pass
+            log_swallowed(logger, "shm consumer ack")
 
     def _finish_consume(self, holder) -> None:
         if holder is not None:
@@ -1267,8 +1267,8 @@ def _ctx_key() -> tuple:
         aid = rt.current_actor_id
         if aid is not None:
             return ("actor", aid)
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 — no runtime: plain thread context
+        log_swallowed(logger, "runtime lookup in _ctx_key")
     return ("thread", threading.get_ident())
 
 
@@ -1325,8 +1325,8 @@ def init_collective_group(
         get_runtime().gcs.kv_put(
             f"collective:{group_name}:{rank}", b"1", namespace="collective"
         )
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 — observability only
+        log_swallowed(logger, "membership kv_put")
 
 
 def _init_distributed_group(world_size: int, rank: int, group_name: str) -> None:
@@ -1421,8 +1421,8 @@ def destroy_collective_group(group_name: str = "default") -> None:
         try:
             get_runtime().gcs.kv_del(getattr(state, "_kv_key", ""),
                                      namespace="collective")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — GCS gone at teardown
+            log_swallowed(logger, "rendezvous kv_del")
 
 
 def get_rank(group_name: str = "default") -> int:

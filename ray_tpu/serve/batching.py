@@ -15,6 +15,16 @@ import time
 from typing import Any, Callable, List, Optional
 
 
+def _wait_slice() -> float:
+    """internal_wait_timeout_s, with its default as the fallback."""
+    try:
+        from ray_tpu.core.config import config
+
+        return config().internal_wait_timeout_s
+    except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+        return 60.0
+
+
 class _Pending:
     __slots__ = ("value", "event", "result", "error")
 
@@ -48,7 +58,18 @@ class _Batcher:
                 self._flusher.start()
         if flush_now:
             self._flush(instance)
-        p.event.wait()
+        # Timed slices with self-healing instead of an untimed park: if the
+        # delayed-flush thread died (teardown, a killed worker) the batch
+        # would otherwise wait forever — re-flush inline. A legitimately
+        # slow batch fn (p dequeued, result pending) just keeps waiting.
+        interval = max(self.timeout_s * 2, 0.05)
+        while not p.event.wait(timeout=interval):
+            interval = _wait_slice()
+            with self._lock:
+                stuck = p in self._queue and (
+                    self._flusher is None or not self._flusher.is_alive())
+            if stuck:
+                self._flush(instance)
         if p.error is not None:
             raise p.error
         return p.result

@@ -17,7 +17,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.utils.logging import get_logger, log_swallowed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+logger = get_logger("serve_controller")
 from ray_tpu.serve.replica import ReplicaActor
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
@@ -125,8 +128,8 @@ class ServeControllerActor:
         for r in victims:
             try:
                 ray_tpu.kill(r)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                log_swallowed(logger, "replica kill at shutdown")
         return True
 
     # -- long poll (reference: long_poll.py LongPollHost) --------------------
@@ -180,8 +183,8 @@ class ServeControllerActor:
                 self._model_poll_tick += 1
                 if self._model_poll_tick % 10 == 0:
                     self._poll_multiplexed_ids()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — loop must survive
+                log_swallowed(logger, "controller reconcile tick")
             time.sleep(0.05)
 
     def _poll_multiplexed_ids(self):
@@ -376,8 +379,8 @@ class ServeControllerActor:
                 if done:
                     try:
                         ray_tpu.kill(replica)
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 — already dead
+                        log_swallowed(logger, "retired replica kill")
                 else:
                     keep.append((replica, since, probe))
             if keep:

@@ -167,6 +167,8 @@ class AsyncCheckpointer:
 
     def save(self, tree: Any, directory: str) -> None:
         host_tree = jax.tree.map(_host_leaf, tree, is_leaf=lambda x: x is None)
+        # raylint: ignore[untimed-wait] — joins our own writer thread, not
+        # a peer; bounded by the filesystem write
         self.wait()
 
         def run():

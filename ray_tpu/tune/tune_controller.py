@@ -6,10 +6,14 @@ Analog of the reference's ``python/ray/tune/execution/tune_controller.py``
 concurrency/resource budget, stream their results through a collector actor,
 feed each result to the scheduler, and execute STOP/RESTART decisions.
 
-Early stop is delivered at the next ``report()``: the trial's report hook
-checks the controller's decision and raises ``_StopTrial`` inside the trial
-function — the deterministic in-runtime analog of the reference killing the
-trial actor.
+Early stop is delivered at ``report()`` itself: the trial's report hook
+pushes the result and then polls the collector until the controller has run
+the scheduler on THAT iteration and acked a decision, raising ``_StopTrial``
+on STOP — the deterministic in-runtime analog of the reference killing the
+trial actor. (An unacked fire-and-forget push would make every scheduler
+decision a race between the trial's next report and the controller's drain
+loop: a fast trainable outruns the controller and HyperBand/ASHA culling
+silently never happens.)
 """
 
 from __future__ import annotations
@@ -37,26 +41,44 @@ class _TuneCollectorImpl:
 
     def __init__(self):
         self.results: List[dict] = []  # [{trial_id, iter, metrics, ckpt}]
-        self.decisions: Dict[str, str] = {}
+        # trial_id -> (highest acked iteration, decision at that iteration):
+        # written by the controller after the scheduler saw the result, read
+        # by the trial's report hook poll (see await_decision).
+        self.acked: Dict[str, tuple] = {}
         self.done: Dict[str, Optional[str]] = {}
 
     def push(self, trial_id: str, iteration: int, metrics: dict, ckpt_path: Optional[str]) -> str:
         self.results.append(
             {"trial_id": trial_id, "iter": iteration, "metrics": metrics, "ckpt": ckpt_path}
         )
-        return self.decisions.get(trial_id, "CONTINUE")
+        return "QUEUED"
 
-    def decide(self, trial_id: str, decision: str):
-        self.decisions[trial_id] = decision
+    def ack_batch(self, acks: List[tuple]):
+        """Controller acks processed results: [(trial_id, iter, decision)]."""
+        for trial_id, iteration, decision in acks:
+            prev = self.acked.get(trial_id)
+            if prev is None or iteration >= prev[0]:
+                self.acked[trial_id] = (iteration, decision)
         return True
+
+    def await_decision(self, trial_id: str, iteration: int) -> Optional[str]:
+        """The decision for ``iteration``, or None if the controller hasn't
+        processed it yet (the trial's report hook polls)."""
+        ent = self.acked.get(trial_id)
+        if ent is not None and ent[0] >= iteration:
+            return ent[1]
+        return None
 
     def finish(self, trial_id: str, error: Optional[str], stopped: bool = False):
         self.done[trial_id] = {"error": error, "stopped": stopped}
         return True
 
     def clear(self, trial_id: str):
-        """Reset decision/done state before a trial relaunch (PBT)."""
-        self.decisions.pop(trial_id, None)
+        """Reset decision/done state before a trial relaunch (PBT). Safe
+        against the old incarnation's results: they are drained in the same
+        atomic drain() as (or before) its finish event, which precedes the
+        relaunch — so no stale high-iteration ack can land after this."""
+        self.acked.pop(trial_id, None)
         self.done.pop(trial_id, None)
         return True
 
@@ -77,7 +99,27 @@ def _trial_main(fn: Callable, config: Dict, trial_id: str, collector, ckpt_path:
         metrics = dict(result.metrics)
         metrics.setdefault("training_iteration", state["i"])
         cp = result.checkpoint.path if result.checkpoint else None
-        decision = ray_tpu.get(collector.push.remote(trial_id, state["i"], metrics, cp))
+        ray_tpu.get(collector.push.remote(trial_id, state["i"], metrics, cp))
+        # Lock-step with the controller: wait until the scheduler has seen
+        # THIS iteration and acked a decision. Bounded so a dead controller
+        # can't park the trial forever (the experiment is lost either way).
+        try:
+            from ray_tpu.core.config import config
+
+            bound = config().internal_wait_timeout_s
+        except Exception:  # noqa: BLE001 — mirror the flag's default
+            bound = 60.0
+        deadline = time.time() + bound
+        decision = "CONTINUE"
+        poll = 0.002  # backs off to 50ms: the controller acks within one
+        while time.time() < deadline:  # drain pass, usually the first poll
+            got = ray_tpu.get(
+                collector.await_decision.remote(trial_id, state["i"]))
+            if got is not None:
+                decision = got
+                break
+            time.sleep(poll)
+            poll = min(poll * 2, 0.05)
         if decision == "STOP":
             raise _StopTrial()
 
@@ -249,9 +291,11 @@ class TuneController:
                 break
 
             results, done = ray_tpu.get(self._collector.drain.remote())
+            acks: List[tuple] = []  # every result gets one — trials block on it
             for r in results:
                 trial = by_id[r["trial_id"]]
                 if trial.is_finished():
+                    acks.append((trial.trial_id, r["iter"], "STOP"))
                     continue
                 metrics = r["metrics"]
                 trial.last_result = metrics
@@ -265,15 +309,19 @@ class TuneController:
                 else:
                     decision = TrialScheduler.CONTINUE
                 if decision == TrialScheduler.STOP:
-                    ray_tpu.get(self._collector.decide.remote(trial.trial_id, "STOP"))
+                    acks.append((trial.trial_id, r["iter"], "STOP"))
                     trial._stop_issued = True
                 elif decision == TrialScheduler.RESTART:
                     # PBT exploit: stop now, respawn with mutated config +
                     # donor checkpoint (scheduler already rewrote trial.config
                     # and trial.restore_checkpoint).
-                    ray_tpu.get(self._collector.decide.remote(trial.trial_id, "STOP"))
+                    acks.append((trial.trial_id, r["iter"], "STOP"))
                     trial.restarts += 1
                     trial._pbt_restart_pending = True
+                else:
+                    acks.append((trial.trial_id, r["iter"], "CONTINUE"))
+            if acks:
+                ray_tpu.get(self._collector.ack_batch.remote(acks))
 
             for trial_id, fin in done.items():
                 trial = by_id[trial_id]

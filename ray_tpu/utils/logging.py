@@ -15,6 +15,22 @@ _FORMAT = "%(asctime)s %(levelname).1s %(process)d %(name)s] %(message)s"
 _configured = False
 
 
+def log_swallowed(logger: logging.Logger, context: str) -> None:
+    """Record an intentionally-swallowed exception instead of `pass`.
+
+    For the `except Exception:` arms in daemon/thread loops where the
+    failure is genuinely expected and non-fatal (peer gone at shutdown,
+    best-effort cleanup): a bare `pass` hides real bugs behind the expected
+    noise, while this keeps the traceback one `RAY_TPU_LOG_LEVEL=DEBUG`
+    away. Call from inside the `except` block; never raises — not even
+    during interpreter teardown.
+    """
+    try:
+        logger.debug("swallowed exception in %s", context, exc_info=True)
+    except Exception:  # raylint: ignore[swallowed-exception] — the helper
+        pass
+
+
 def get_logger(component: str) -> logging.Logger:
     global _configured
     if not _configured:

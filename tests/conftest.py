@@ -45,7 +45,32 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import signal  # noqa: E402
 import threading  # noqa: E402
 
+# Opt-in runtime lock-order validation (ray_tpu.devtools.lockcheck): with
+# RAY_TPU_LOCK_ORDER_CHECK_ENABLED=1 every threading.Lock/RLock/Condition
+# is instrumented — per-thread held-sets, a global acquisition-order graph,
+# LockOrderError on inversion. ray_tpu/__init__ installs the wrappers at
+# the TOP of the package import (so module-level locks like
+# runtime._init_lock and collectives._groups_lock are covered too); this
+# import triggers that, and the env var propagates to spawned cluster
+# processes, which instrument the same way when they import ray_tpu.
+from ray_tpu.devtools import lockcheck as _lockcheck  # noqa: E402
+
+_LOCKCHECK_ON = _lockcheck.maybe_install()
+
 TEST_TIMEOUT_S = 180  # matches the reference's pytest.ini per-test timeout
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard():
+    """With lockcheck installed, fail any test during which an inversion was
+    recorded — even one raised (and swallowed) on a daemon thread."""
+    if not _LOCKCHECK_ON:
+        yield
+        return
+    before = len(_lockcheck.violations())
+    yield
+    new = _lockcheck.violations()[before:]
+    assert not new, "lock-order violations during test:\n" + "\n".join(new)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,375 @@
+"""raylint + lockcheck: the static pass trips on seeded violations, the
+whole tree is clean against the checked-in baseline, and the runtime
+validator catches a provoked inversion.
+
+The clean-tree test IS the CI gate: a new lock inversion, blocking call
+under a lock, untimed wait, swallowed exception, RPC-surface typo, or
+unknown config knob anywhere in ray_tpu/ fails tier-1 until fixed or
+explicitly accepted with ``--update-baseline``.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.devtools import lint
+from ray_tpu.devtools import lockcheck
+
+
+def _write(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# seeded fixture snippets — each must trip its check
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Inverted:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def path1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def path2(self):
+                with self._b:
+                    self._helper()   # interprocedural: _helper takes _a
+
+            def _helper(self):
+                with self._a:
+                    pass
+        """)
+    findings = lint.lint_tree(str(tmp_path))
+    cycles = [f for f in findings if f.check == "lock-order"]
+    assert cycles, findings
+    assert "Inverted._a" in cycles[0].message
+    assert "Inverted._b" in cycles[0].message
+
+
+def test_self_deadlock_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._l = threading.Lock()
+                self._cv = threading.Condition(self._l)
+
+            def bad(self):
+                with self._l:
+                    with self._cv:   # same underlying non-reentrant lock
+                        pass
+        """)
+    findings = lint.lint_tree(str(tmp_path))
+    assert any(f.check == "lock-order" and "self-deadlock" in f.detail
+               for f in findings), findings
+
+
+def test_blocking_under_lock_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import subprocess
+        import threading
+        import time
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Condition()
+                self.sock = None
+                self.peer = None
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    self.sock.recv(4096)
+                    self.peer.call("ping")
+                    self._other.wait(1.0)
+                    subprocess.check_output(["true"])
+                    open("/tmp/x")
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "blocking-under-lock"]
+    kinds = {f.detail.split(":")[0] for f in findings}
+    assert {"sleep", "socket", "rpc", "wait", "subprocess",
+            "file-io"} <= kinds, findings
+
+
+def test_wait_on_own_condition_not_flagged(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Ok:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def fine(self):
+                with self._lock:
+                    self._cv.wait(timeout=1.0)  # releases _lock: not blocking
+        """)
+    findings = lint.lint_tree(str(tmp_path))
+    assert not [f for f in findings if f.check == "blocking-under-lock"], \
+        findings
+
+
+def test_untimed_wait_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._ev = threading.Event()
+
+            def park(self, fut):
+                self._ev.wait()
+                return fut.result()
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "untimed-wait"]
+    assert len(findings) == 2, findings
+    assert {f.detail.split(":")[0] for f in findings} == {"wait", "result"}
+
+
+def test_swallowed_exception_detected_and_log_swallowed_not(tmp_path):
+    _write(tmp_path, "mod.py", """
+        def loop():
+            try:
+                step()
+            except Exception:
+                pass
+
+        def fixed(logger):
+            try:
+                step()
+            except Exception:
+                log_swallowed(logger, "step in loop")
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "swallowed-exception"]
+    assert len(findings) == 1 and findings[0].scope == "loop", findings
+
+
+def test_rpc_surface_unknown_method_detected(tmp_path):
+    _write(tmp_path, "svc.py", """
+        class FooService:
+            def ping(self):
+                return "pong"
+
+        def serve():
+            service = FooService()
+            return RpcServer(service, name="foo")
+
+        def use(client):
+            client.call("ping")               # resolves
+            client.call("not_a_method")       # typo: flagged
+            client.notify("_private")         # private: flagged
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-surface"]
+    details = {f.detail for f in findings}
+    assert details == {"unknown:not_a_method", "private:_private"}, findings
+
+
+def test_config_knob_checks(tmp_path):
+    _write(tmp_path, "core/config.py", """
+        class _Flag:
+            def __init__(self, default):
+                self.default = default
+
+        class Config:
+            # a documented, used knob
+            good_knob = _Flag(1)
+            orphan_knob = _Flag(2)
+        """)
+    _write(tmp_path, "user.py", """
+        from core.config import config
+
+        def f():
+            cfg = config()
+            return cfg.good_knob + cfg.not_a_knob
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "config-knob"]
+    details = {f.detail for f in findings}
+    assert "unknown:not_a_knob" in details, findings
+    assert "unused:orphan_knob" in details, findings
+    assert "undocumented:orphan_knob" in details, findings
+    assert not any("good_knob" in d for d in details), findings
+
+
+def test_pragma_suppresses_reviewed_false_positive(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+        import time
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reviewed(self):
+                with self._lock:
+                    # raylint: ignore[blocking-under-lock] — bounded 1ms
+                    time.sleep(0.001)
+        """)
+    findings = lint.lint_tree(str(tmp_path))
+    assert not [f for f in findings if f.check == "blocking-under-lock"], \
+        findings
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow + the CI gate
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_update_then_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        def loop():
+            try:
+                step()
+            except Exception:
+                pass
+        """)
+    baseline = tmp_path / "baseline.txt"
+    # dirty against an empty baseline
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline), "-q"])
+    assert rc == 1
+    # accept, then clean
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline),
+                    "--update-baseline"])
+    assert rc == 0
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline), "-q"])
+    assert rc == 0
+    # a NEW finding fails again; the accepted one stays accepted
+    _write(tmp_path, "mod2.py", """
+        def loop2():
+            try:
+                step()
+            except Exception:
+                pass
+        """)
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline), "-q"])
+    assert rc == 1
+
+
+def test_tree_is_clean_against_checked_in_baseline():
+    """THE tier-1 gate: `python -m ray_tpu.devtools.lint` on the real tree
+    must exit 0 against the committed baseline."""
+    rc = lint.main(["-q"])
+    assert rc == 0, ("raylint found NEW violations — fix them or accept "
+                     "deliberately with --update-baseline")
+
+
+def test_tree_scan_covers_known_hot_modules():
+    """The scan actually sees the concurrency-heavy modules (guards against
+    a silently-wrong default scan root)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    linter = lint.Linter(root)
+    linter.run()
+    scanned = set(linter.src_lines)
+    assert {"core/gcs_server.py", "core/core_worker.py", "core/rpc.py",
+            "parallel/collectives.py", "core/object_store.py"} <= scanned
+    # the RPC surface map found every service handler
+    assert {"GcsService", "NodeDaemon", "WorkerService", "_OwnerService",
+            "_MemberService"} <= set(linter.services)
+    # the knob registry was located
+    assert linter.flags and linter.flag_path == "core/config.py"
+
+
+# ---------------------------------------------------------------------------
+# runtime lockcheck
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def checked():
+    installed_before = lockcheck.installed()
+    lockcheck.install(fresh_graph=not installed_before)
+    before = len(lockcheck.violations())
+    yield lockcheck
+    # drop violations this test provoked on purpose, then restore state
+    with lockcheck._state_lock:
+        del lockcheck._violations[before:]
+    if not installed_before:
+        lockcheck.uninstall()
+
+
+def test_lockcheck_catches_cross_thread_inversion(checked):
+    A = threading.Lock()
+    B = threading.Lock()
+    caught = []
+
+    def t1():
+        with A:
+            with B:
+                pass
+
+    def t2():
+        try:
+            with B:
+                with A:
+                    pass
+        except lockcheck.LockOrderError as e:
+            caught.append(e)
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    th = threading.Thread(target=t2)
+    th.start()
+    th.join()
+    assert caught, "inversion not raised"
+    assert "inversion" in str(caught[0])
+    assert any("inversion" in v for v in lockcheck.violations())
+
+
+def test_lockcheck_consistent_order_and_reentrancy_ok(checked):
+    A = threading.Lock()
+    B = threading.Lock()
+    R = threading.RLock()
+    for _ in range(3):
+        with A:
+            with B:
+                with R:
+                    with R:  # reentrant: fine
+                        pass
+    cv = threading.Condition(A)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5.0)
+            hits.append(1)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    import time
+
+    time.sleep(0.1)
+    with cv:
+        cv.notify_all()
+    th.join()
+    assert hits == [1]
+
+
+def test_lockcheck_self_deadlock(checked):
+    L = threading.Lock()
+    with pytest.raises(lockcheck.LockOrderError, match="self-deadlock"):
+        with L:
+            L.acquire()
