@@ -193,6 +193,19 @@ class Config:
     # tests lower this to fail fast on a lost rank.
     collective_timeout_s = _Flag(120.0)
 
+    # -- metrics / observability ----------------------------------------------
+    # Cluster-wide metrics pipeline: every process (gcs_server, node_daemon,
+    # worker, driver) runs an exporter thread that snapshots its
+    # util.metrics registry and ships it to the GCS, which serves the merged
+    # exposition at the dashboard's /metrics. 0 disables both the exporters
+    # AND the built-in hot-path instrumentation (task phase histograms,
+    # serve latency, object-plane counters).
+    metrics_export_enabled = _Flag(True)
+    # Seconds between exporter ticks (the reference's metrics agent reports
+    # on the same ~10s cadence). Read every tick, so a cluster-adopted
+    # config applies without an exporter restart.
+    metrics_export_interval_s = _Flag(10.0)
+
     # -- debugging ------------------------------------------------------------
     # Opt-in runtime lock-order validator (ray_tpu.devtools.lockcheck):
     # threading.Lock/RLock/Condition are replaced with instrumented wrappers

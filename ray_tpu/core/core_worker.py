@@ -142,6 +142,25 @@ class _GcsClientAdapter:
     def task_events(self) -> List[dict]:
         return self._client.call("task_events")
 
+    def task_events_since(self, cursor, limit: int = 1000):
+        """Cursor'd task-event poll: (next_cursor, new_events)."""
+        return self._client.call("task_events_since", cursor, limit)
+
+    # -- cluster metrics plane ------------------------------------------------
+
+    def report_metrics(self, node_id: str, component: str, pid: int,
+                       snapshot: list) -> None:
+        # Coalescable one-way notify: exporter ticks must never block on
+        # (or crash with) a restarting GCS.
+        self._client.notify("report_metrics", node_id, component, pid,
+                            snapshot)
+
+    def metrics_text(self) -> str:
+        return self._client.call("metrics_text")
+
+    def metrics_summary(self) -> dict:
+        return self._client.call("metrics_summary")
+
     def poll_channel(self, channel: str, cursor: int,
                      poll_timeout: float = 0.0):
         """Read a pubsub channel from ``cursor``; returns (end, messages).
@@ -874,6 +893,30 @@ class CoreWorker:
         self.blocked_on_get = None
         self.unblocked_after_get = None
         self._shutdown = False
+
+        # Metrics plane: this process's exporter ships the registry to the
+        # GCS every metrics_export_interval_s (component = driver | worker).
+        from ray_tpu.core.metrics_export import MetricsExporter
+
+        self._metrics_exporter = MetricsExporter(
+            report=self.gcs.report_metrics,
+            node_id=self.current_node_id.hex() if self.current_node_id
+            else "", component=mode,
+            collectors=[self._collect_core_metrics]).start()
+
+    def _collect_core_metrics(self) -> None:
+        """Mirror this core's object-plane read stats + spec-cache hit rate
+        into gauges (runs only at export ticks, never on hot paths)."""
+        from ray_tpu.core.metrics_export import gauge, mirror_stats_gauge
+
+        mirror_stats_gauge(
+            "ray_tpu_object_reads",
+            "Object-plane read-path counters (locate calls, push wakeups, "
+            "poll timeouts, backoff sleeps)", self._stats)
+        spec = self._spec_encoder.stats()
+        gauge("ray_tpu_spec_cache_hit_rate",
+              "Cached task-spec encoding wire hit rate").set(
+            float(spec["hit_rate"]))
 
     # ====================== objects ======================
 
@@ -3118,6 +3161,7 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        self._metrics_exporter.stop()
         # Flush __del__-deferred releases while the owner/GCS connections
         # are still open (deregistrations and frees ride RPCs).
         self._ref_release_stop.set()
@@ -3169,6 +3213,9 @@ class CoreWorker:
 
         if runtime_mod._global_runtime is self:
             runtime_mod._global_runtime = None
+            from ray_tpu.util.state import _reset_task_cache
+
+            _reset_task_cache()
 
 
 _MISSING = object()

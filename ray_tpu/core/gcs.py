@@ -102,6 +102,14 @@ class GlobalControlStore:
         self._functions: Dict[str, Any] = {}
         self.pubsub = PubSub()
         self._task_events: List[dict] = []
+        # Absolute index of _task_events[0] (events truncated off the front
+        # advance it) — the cursor space of task_events_since.
+        self._task_event_base = 0
+        # Cluster metrics plane: per-(node, component, pid) series store fed
+        # by every process's exporter (metrics_agent → gcs analog).
+        from ray_tpu.util.metrics import MetricsAggregator
+
+        self.metrics = MetricsAggregator()
 
     # -- nodes (gcs_node_manager.cc) -----------------------------------------
 
@@ -230,8 +238,45 @@ class GlobalControlStore:
         with self._lock:
             self._task_events.append(event)
             if len(self._task_events) > 100_000:
-                del self._task_events[: len(self._task_events) // 2]
+                drop = len(self._task_events) // 2
+                del self._task_events[:drop]
+                self._task_event_base += drop
 
     def task_events(self) -> List[dict]:
         with self._lock:
             return list(self._task_events)
+
+    def task_events_since(self, cursor: Optional[int],
+                          limit: int = 1000) -> Tuple[int, List[dict]]:
+        """Incremental task-event read: ``(next_cursor, events)``.
+
+        ``cursor`` is an absolute event index (events truncated off the
+        front are skipped, same as the pubsub log); ``None`` tails from the
+        end, returning at most the newest ``limit`` events — pollers store
+        the returned cursor so every subsequent poll copies only NEW events
+        instead of the whole (up to 100k-entry) log.
+        """
+        with self._lock:
+            end = self._task_event_base + len(self._task_events)
+            if cursor is None:
+                lo = max(0, len(self._task_events) - limit) if limit else 0
+            else:
+                # A cursor past the end (GCS restarted with a fresh, shorter
+                # log) clamps to the end: the poller resyncs going forward.
+                lo = min(max(0, cursor - self._task_event_base),
+                         len(self._task_events))
+            events = (self._task_events[lo:lo + limit] if limit
+                      else self._task_events[lo:])
+            return self._task_event_base + lo + len(events), events
+
+    # -- cluster metrics (metrics_agent.py → src/ray/stats/ analog) ----------
+
+    def report_metrics(self, node_id: str, component: str, pid: int,
+                       snapshot: List[dict]) -> None:
+        self.metrics.report(node_id, component, pid, snapshot)
+
+    def metrics_text(self) -> str:
+        return self.metrics.prometheus_text()
+
+    def metrics_summary(self) -> dict:
+        return self.metrics.summary()

@@ -151,6 +151,13 @@ class GcsService:
             target=self._health_loop, name="gcs-health", daemon=True
         )
         self._monitor.start()
+        # The GCS exports its own registry too (component="gcs") — straight
+        # into the local aggregator, no RPC hop.
+        from ray_tpu.core.metrics_export import MetricsExporter
+
+        self._metrics_exporter = MetricsExporter(
+            report=self.store.report_metrics, node_id="head",
+            component="gcs", collectors=[self._collect_gcs_metrics]).start()
         if snapshot_path:
             threading.Thread(
                 target=self._snapshot_loop, name="gcs-snapshot", daemon=True
@@ -940,6 +947,41 @@ class GcsService:
     def task_events(self) -> List[dict]:
         return self.store.task_events()
 
+    def task_events_since(self, cursor: Optional[int],
+                          limit: int = 1000) -> Tuple[int, List[dict]]:
+        """Cursor'd task-event read — dashboard/state pollers ship only the
+        delta instead of copying the whole event log every 2s."""
+        return self.store.task_events_since(cursor, limit)
+
+    # ====================== cluster metrics plane ======================
+
+    def report_metrics(self, node_id: str, component: str, pid: int,
+                       snapshot: List[dict]) -> None:
+        """Per-process exporter reports land here (one coalescable notify
+        per process per export interval — metrics_agent → GCS analog)."""
+        self.store.report_metrics(node_id, component, pid, snapshot)
+
+    def metrics_text(self) -> str:
+        """Merged cluster-wide Prometheus exposition (dashboard /metrics)."""
+        return self.store.metrics_text()
+
+    def metrics_summary(self) -> dict:
+        """JSON rollup of the live series store (dashboard UI pane)."""
+        return self.store.metrics_summary()
+
+    def _collect_gcs_metrics(self) -> None:
+        """Control-plane gauges: scheduler queue depth + lease/node counts."""
+        from ray_tpu.core.metrics_export import mirror_stats_gauge
+
+        with self._lock:
+            st = {"pending_demands": len(self._waiting_demands),
+                  "leases": len(self._leases),
+                  "alive_nodes": len(self._node_addr)}
+        mirror_stats_gauge(
+            "ray_tpu_gcs_sched",
+            "GCS scheduler state (pending demands, live leases, alive "
+            "nodes)", st)
+
     # ====================== pubsub (long-poll) ======================
 
     def _publish(self, channel: str, message: Any) -> None:
@@ -1149,6 +1191,7 @@ class GcsService:
 
     def shutdown(self) -> None:
         self._stopped.set()
+        self._metrics_exporter.stop()
         try:
             self._snapshot()
         except Exception:  # noqa: BLE001 — shutdown is best-effort

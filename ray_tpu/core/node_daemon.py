@@ -198,6 +198,15 @@ class NodeDaemon:
             for _ in range(prestart):
                 self._spawn_worker()
                 self._spawn_pending += 1
+        # Metrics plane: export this daemon's registry + store/pool gauges
+        # to the GCS (started after set_config so the adopted cluster
+        # interval applies from the first tick).
+        from ray_tpu.core.metrics_export import MetricsExporter
+
+        self._metrics_exporter = MetricsExporter(
+            report=lambda *a: self._gcs.notify("report_metrics", *a),
+            node_id=self.node_id.hex(), component="node_daemon",
+            collectors=[self._collect_node_metrics]).start()
         threading.Thread(target=self._heartbeat_loop, name="daemon-heartbeat",
                          daemon=True).start()
         threading.Thread(target=self._reaper_loop, name="daemon-reaper",
@@ -243,6 +252,7 @@ class NodeDaemon:
 
     def shutdown(self) -> None:
         self._stopped.set()
+        self._metrics_exporter.stop()
         with self._pool_lock:
             workers = list(self._workers.values())
         for w in workers:
@@ -1396,6 +1406,25 @@ class NodeDaemon:
                     victim.proc.kill()
                 except OSError:
                     pass
+
+    def _collect_node_metrics(self) -> None:
+        """Store occupancy + worker-pool gauges for the exporter tick."""
+        from ray_tpu.core.metrics_export import gauge, mirror_stats_gauge
+
+        st = self.stats()
+        mirror_stats_gauge(
+            "ray_tpu_node_store",
+            "Node object-plane occupancy (shm bytes in use, store "
+            "capacity, heap objects, spilled objects)",
+            {"shm_bytes": st["shm_bytes"],
+             "capacity_bytes": self._shm.capacity() if self._shm else 0,
+             "heap_objects": st["heap_objects"],
+             "spilled_objects": len(self._spilled)})
+        w = gauge("ray_tpu_node_workers",
+                  "Worker-pool occupancy on this node",
+                  tag_keys=("state",))
+        w.set(float(st["workers"]), {"state": "total"})
+        w.set(float(st["idle"]), {"state": "idle"})
 
     def stats(self) -> dict:
         with self._pool_lock:

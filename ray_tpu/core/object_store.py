@@ -78,6 +78,9 @@ class MemoryStore:
         # arena blocks whose delete was refused (reader still pinned);
         # retried on subsequent puts and deletes
         self._shm_garbage: set = set()
+        # Lifetime counters surfaced by stats() (→ the metrics plane).
+        self._evictions = 0
+        self._restores = 0
 
     # -- write path -----------------------------------------------------------
 
@@ -285,6 +288,8 @@ class MemoryStore:
                 "num_objects": len(self._objects),
                 "used_bytes": self._used,
                 "capacity_bytes": self._capacity,
+                "evictions": self._evictions,
+                "restores": self._restores,
             }
 
     # -- spilling (holds lock) ------------------------------------------------
@@ -321,6 +326,7 @@ class MemoryStore:
             self._deser_cache.pop(oid, None)
             freed += entry.size
             self._used -= entry.size
+            self._evictions += 1
         if freed < bytes_needed:
             logger.warning(
                 "object store over capacity and could not spill enough "
@@ -336,6 +342,7 @@ class MemoryStore:
             blob = f.read()
         entry.serialized = SerializedObject.from_bytes(blob)
         self._used += entry.size
+        self._restores += 1
         if self._used > self._capacity:
             # A restore is a write too: re-admitting the spilled bytes can
             # push the store over capacity — spill colder entries to make

@@ -25,6 +25,16 @@ from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("object_transfer")
 
+# Per-process pull-path counters (plain int stores under the GIL — stats,
+# not invariants; mirrored into gauges by the metrics exporter's collector).
+_PULL_STATS = {"bytes": 0, "chunks": 0, "reassigned_ranges": 0,
+               "failed_sources": 0}
+
+
+def pull_stats() -> dict:
+    """Snapshot of the process-wide chunked-pull counters."""
+    return dict(_PULL_STATS)
+
 
 class PullBudget:
     """Global cap on in-flight pulled bytes (pull_manager.cc's
@@ -183,6 +193,8 @@ class PullManager:
                 return
             if not getattr(fut, "dest_written", False):
                 fast_copy_into(dest, off, chunk)
+            _PULL_STATS["bytes"] += length
+            _PULL_STATS["chunks"] += 1
             with st["cv"]:
                 st["remaining"] -= 1
                 if st["remaining"] == 0:
@@ -198,6 +210,8 @@ class PullManager:
                 client.release_dests([f for _, _, f in inflight])
             except Exception:  # noqa: BLE001 — connection already torn down
                 log_swallowed(logger, "release_dests on dead connection")
+        _PULL_STATS["failed_sources"] += 1
+        _PULL_STATS["reassigned_ranges"] += len(inflight) + len(taken)
         with st["cv"]:
             for off, length, _f in inflight:
                 st["queue"].append((off, length))

@@ -46,6 +46,7 @@ from ray_tpu.core.exceptions import (
     TaskError,
 )
 from ray_tpu.core.gcs import ActorInfo, GlobalControlStore, JobInfo, NodeInfo
+from ray_tpu.core.metrics_export import observe_task_phases
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.object_store import MemoryStore
@@ -372,6 +373,24 @@ class Runtime:
         for i in range(num_nodes):
             self.add_node(dict(base), dict(labels or {}))
         self.head_node_id = next(iter(self.nodes))
+
+        # Metrics plane: the in-process runtime reports straight into its
+        # GCS store's aggregator — same pipeline, no RPC hop.
+        from ray_tpu.core.metrics_export import MetricsExporter
+
+        self._metrics_exporter = MetricsExporter(
+            report=self.gcs.report_metrics,
+            node_id=self.head_node_id.hex(), component="driver",
+            collectors=[self._collect_runtime_metrics]).start()
+
+    def _collect_runtime_metrics(self) -> None:
+        """Object-store occupancy gauges for the exporter tick."""
+        from ray_tpu.core.metrics_export import mirror_stats_gauge
+
+        mirror_stats_gauge(
+            "ray_tpu_object_store",
+            "In-process object-store occupancy and spill counters",
+            self.store.stats())
 
     # -- topology -------------------------------------------------------------
 
@@ -721,6 +740,10 @@ class Runtime:
         self._ctx.held_resources = held
         self._ctx.held_node = node.node_id
         started = time.time()
+        # Lifecycle phase stamps (same split as the multiprocess worker's
+        # execute loop): submit→dispatch, dep fetch, user-code runtime.
+        phases = ({"queued": max(0.0, started - spec.submit_ts)}
+                  if spec.submit_ts else {})
         failure: Optional[BaseException] = None
         try:
             if state.cancelled:
@@ -729,21 +752,30 @@ class Runtime:
             if fn is None:
                 raise RuntimeError(f"function {spec.function_id} not found in GCS")
             args, kwargs = self._fetch_args(spec)
+            t_args = time.time()
+            phases["args_fetch"] = t_args - started
             from ray_tpu.runtime_env import applied as _renv
 
             with _renv(spec.options.runtime_env):
                 result = fn(*args, **kwargs)
+            phases["execute"] = time.time() - t_args
+            if spec.submit_ts:
+                phases["total"] = max(0.0, time.time() - spec.submit_ts)
             self._store_results(state, result)
+            observe_task_phases(phases)
             self.gcs.record_task_event(
                 {"task_id": spec.task_id.hex(), "name": spec.function_name, "state": "FINISHED",
-                 "time": time.time(), "duration": time.time() - started, "node_id": node.node_id.hex()}
+                 "time": time.time(), "duration": time.time() - started, "node_id": node.node_id.hex(),
+                 "phases": {k: round(v, 6) for k, v in phases.items()}}
             )
         except _DependencyFailed as df:
             self._store_error(state, df.error)
+            observe_task_phases(phases, ok=False)
         except TaskCancelledError:
             self._finish_cancelled(state)
         except BaseException as e:  # noqa: BLE001 — worker boundary
             failure = e
+            observe_task_phases(phases, ok=False)
         finally:
             self._ctx.in_worker = False
             self._ctx.task_state = None
@@ -1092,20 +1124,32 @@ class Runtime:
         self._ctx.actor_id = runner.actor_id
         self._ctx.node_id = runner.node_id
         self._ctx.in_worker = True
+        started = time.time()
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
             method = _resolve_actor_method(runner.instance, spec.actor_method)
             args, kwargs = self._fetch_args(spec)
+            t_args = time.time()
             result = method(*args, **kwargs)
             self._store_results(state, result)
+            phases = {"args_fetch": t_args - started,
+                      "execute": time.time() - t_args}
+            if spec.submit_ts:
+                phases["queued"] = max(0.0, started - spec.submit_ts)
+                phases["total"] = max(0.0, time.time() - spec.submit_ts)
+            observe_task_phases(phases)
         except _DependencyFailed as df:
             self._store_error(state, df.error)
+            observe_task_phases({"queued": max(0.0, started - spec.submit_ts)}
+                                if spec.submit_ts else {}, ok=False)
         except TaskCancelledError:
             self._finish_cancelled(state)
         except BaseException as e:  # noqa: BLE001
             # Method exceptions don't kill the actor (reference semantics).
             self._store_error(state, TaskError.from_exception(f"{spec.function_name}.{spec.actor_method}", e))
+            observe_task_phases({"queued": max(0.0, started - spec.submit_ts)}
+                                if spec.submit_ts else {}, ok=False)
         finally:
             self._ctx.in_worker = False
             self._ctx.task_id = None
@@ -1208,6 +1252,10 @@ class Runtime:
         return self._ctx.node_id or self.head_node_id
 
     def shutdown(self) -> None:
+        self._metrics_exporter.stop()
+        from ray_tpu.util.state import _reset_task_cache
+
+        _reset_task_cache()
         for actor_id in list(self.actors):
             try:
                 self.kill_actor(actor_id)

@@ -11,6 +11,7 @@ multiprocess runtime.
 from __future__ import annotations
 
 import enum
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -170,6 +171,11 @@ class TaskSpec:
     # Tracing: (trace_id, parent_span_id) of the submitting context —
     # cross-process span propagation (tracing_helper.py:169-175 analog).
     trace_ctx: Optional[tuple] = None
+    # Wall-clock submission stamp (set at spec construction): the executing
+    # worker derives the "queued" and "total" task lifecycle phases from it
+    # (submit → execution start / submit → finish). Wall time, not
+    # monotonic, because it crosses processes; 0.0 = unknown.
+    submit_ts: float = field(default_factory=time.time)
 
     def return_object_ids(self, num: Optional[int] = None) -> List[ObjectID]:
         n = num if num is not None else (
@@ -200,7 +206,8 @@ class TaskSpec:
             self.options, self.actor_id, self.actor_method,
             self.actor_creation_class_id, self.sequence_number,
             self.caller_id, self.window_min, self.concurrency_group,
-            self.attempt_number, self.owner_addr, self.trace_ctx))
+            self.attempt_number, self.owner_addr, self.trace_ctx,
+            self.submit_ts))
 
 
 def _make_task_spec(task_id, job_id, task_type_value, *rest) -> TaskSpec:
@@ -240,13 +247,15 @@ def spec_template_fields(spec: TaskSpec) -> tuple:
 def spec_var_fields(spec: TaskSpec) -> tuple:
     """The per-call portion of a spec."""
     return (spec.task_id, spec.args, spec.kwargs, spec.sequence_number,
-            spec.window_min, spec.attempt_number, spec.trace_ctx)
+            spec.window_min, spec.attempt_number, spec.trace_ctx,
+            spec.submit_ts)
 
 
 def assemble_spec(tfields: tuple, vfields: tuple) -> TaskSpec:
     (job_id, ttype, function_id, function_name, options, actor_id,
      actor_method, acc_id, caller_id, cgroup, owner_addr) = tfields
-    (task_id, args, kwargs, seq, window_min, attempt, trace_ctx) = vfields
+    (task_id, args, kwargs, seq, window_min, attempt, trace_ctx,
+     submit_ts) = vfields
     return TaskSpec(
         task_id=task_id, job_id=job_id, task_type=TaskType(ttype),
         function_id=function_id, function_name=function_name, args=args,
@@ -254,7 +263,7 @@ def assemble_spec(tfields: tuple, vfields: tuple) -> TaskSpec:
         actor_method=actor_method, actor_creation_class_id=acc_id,
         sequence_number=seq, caller_id=caller_id, window_min=window_min,
         concurrency_group=cgroup, attempt_number=attempt,
-        owner_addr=owner_addr, trace_ctx=trace_ctx)
+        owner_addr=owner_addr, trace_ctx=trace_ctx, submit_ts=submit_ts)
 
 
 class SpecEncoder:

@@ -218,7 +218,9 @@ class WorkerService:
         tracing.set_context((trace_id, span_id))
         return (trace_id, span_id, parent, time.time())
 
-    def _end_trace(self, spec: TaskSpec, trace: tuple, ok: bool) -> None:
+    def _end_trace(self, spec: TaskSpec, trace: tuple, ok: bool,
+                   phases: Optional[dict] = None) -> None:
+        from ray_tpu.core.metrics_export import observe_task_phases
         from ray_tpu.util import tracing
 
         tracing.set_context(None)
@@ -226,18 +228,25 @@ class WorkerService:
         name = spec.function_name
         if spec.actor_method:
             name = f"{name}.{spec.actor_method}"
-        self._events.record({
+        now = time.time()
+        if phases is not None and spec.submit_ts:
+            phases["total"] = max(0.0, now - spec.submit_ts)
+        event = {
             "task_id": spec.task_id.hex(),
             "name": name,
             "state": "FINISHED" if ok else "FAILED",
-            "time": time.time(),
-            "duration": time.time() - started,
+            "time": now,
+            "duration": now - started,
             "node_id": self.core.current_node_id.hex()
             if self.core.current_node_id else "",
             "trace_id": trace_id,
             "span_id": span_id,
             "parent_span_id": parent,
-        })
+        }
+        if phases:
+            event["phases"] = {k: round(v, 6) for k, v in phases.items()}
+            observe_task_phases(phases, ok=ok)
+        self._events.record(event)
 
     def register_spec_template(self, digest: bytes, blob: bytes) -> None:
         """Called by the RPC server's connection loop on "tmpl" frames."""
@@ -257,6 +266,12 @@ class WorkerService:
               "resources": spec.declared_resources(), "released": False}
         self._task_lease.value = st
         trace = self._begin_trace(spec)
+        # Lifecycle phase stamps (task lifecycle histogram): submit→here is
+        # the queued phase (wire + lease + scheduling), then dep fetch, then
+        # user-code runtime; _end_trace adds submit→finish as "total".
+        t_recv = time.time()
+        phases = ({"queued": max(0.0, t_recv - spec.submit_ts)}
+                  if spec.submit_ts else {})
         borrowed: set = set()
         try:
             fn = self.core.gcs.get_function(spec.function_id)
@@ -264,7 +279,10 @@ class WorkerService:
                 raise RuntimeError(f"function {spec.function_id} not in GCS")
             with arg_borrow_scope() as borrowed:
                 args, kwargs = self._resolve_args(spec)
+            t_args = time.time()
+            phases["args_fetch"] = t_args - t_recv
             result = fn(*args, **kwargs)
+            phases["execute"] = time.time() - t_args
             args = kwargs = None  # drop frame pins before the borrow audit
             # Lineage = the full spec pickle. Cached-template calls carry
             # no full pickle on the wire, so it is rebuilt lazily — only
@@ -281,7 +299,7 @@ class WorkerService:
         finally:
             self._task_lease.value = None
             self.core.current_task_id = None
-        self._end_trace(spec, trace, ok=bool(out.get("ok")))
+        self._end_trace(spec, trace, ok=bool(out.get("ok")), phases=phases)
         # Borrow handover BEFORE the reply: the caller's call-duration pin
         # is released when it processes this reply, so any arg ref this
         # process still holds must be registered with its owner first
@@ -588,6 +606,11 @@ class WorkerService:
         from ray_tpu.core.core_worker import arg_borrow_scope
 
         trace = self._begin_trace(spec)
+        # Phase stamps: "queued" spans submit → admission (wire + per-caller
+        # sequence ordering); the admitted timestamp anchors args/execute.
+        t_admit = time.time()
+        phases = ({"queued": max(0.0, t_admit - spec.submit_ts)}
+                  if spec.submit_ts else {})
         borrowed: set = set()
         try:
             if spec.actor_method == DAG_LOOP_METHOD:
@@ -606,6 +629,8 @@ class WorkerService:
             method, is_coro = entry
             with arg_borrow_scope() as borrowed:
                 args, kwargs = self._resolve_args(spec)
+            t_args = time.time()
+            phases["args_fetch"] = t_args - t_admit
             if is_coro:
                 from ray_tpu.util import tracing
 
@@ -630,6 +655,7 @@ class WorkerService:
             else:
                 with state.slots:
                     result = method(*args, **kwargs)
+            phases["execute"] = time.time() - t_args
             args = kwargs = None  # drop frame pins before the borrow audit
             out = self._package_results(spec, result)
             result = None
@@ -640,7 +666,7 @@ class WorkerService:
                 spec,
                 TaskError.from_exception(
                     f"{spec.function_name}.{spec.actor_method}", exc))
-        self._end_trace(spec, trace, ok=bool(out.get("ok")))
+        self._end_trace(spec, trace, ok=bool(out.get("ok")), phases=phases)
         # Borrow handover before the reply (see run_task): an arg ref the
         # method stored in ACTOR STATE must be registered with its owner
         # before the caller's call-duration pin is released.

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+from collections import deque
 from typing import Optional
 
 
@@ -22,6 +23,11 @@ class Dashboard:
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
+        # Cursor'd task-event feed: each /api/events poll fetches only NEW
+        # events past this cursor; the rolling cache serves the pane.
+        self._ev_lock = threading.Lock()
+        self._ev_cursor: Optional[int] = None
+        self._ev_cache: deque = deque(maxlen=500)
 
     def start(self) -> "Dashboard":
         self._thread = threading.Thread(target=self._serve, daemon=True)
@@ -63,7 +69,9 @@ class Dashboard:
         # dashboard/modules/log/log_manager.py, modules/event/) over the
         # existing GCS log aggregation and task-event pipeline.
         app.router.add_get("/api/logs", self._logs)
-        app.router.add_get("/api/events", self._json(_task_event_feed))
+        app.router.add_get("/api/events", self._json(self._task_event_feed))
+        app.router.add_get("/api/metrics_summary",
+                           self._json(_metrics_summary))
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/timeline", self._timeline)
 
@@ -130,9 +138,9 @@ class Dashboard:
     async def _metrics(self, request):
         from aiohttp import web
 
-        from ray_tpu.util.metrics import prometheus_text
-
-        return web.Response(text=prometheus_text(), content_type="text/plain")
+        loop = asyncio.get_event_loop()
+        text = await loop.run_in_executor(None, _cluster_metrics_text)
+        return web.Response(text=text, content_type="text/plain")
 
     async def _timeline(self, request):
         from aiohttp import web
@@ -149,32 +157,87 @@ class Dashboard:
         return web.Response(text=_INDEX_HTML, content_type="text/html")
 
 
+    def _task_event_feed(self, limit: int = 500):
+        """Most recent task/span events from the GCS task-event store
+        (``gcs_task_manager.cc`` analog), newest first.
+
+        Incremental: each poll ships only events past the stored cursor
+        (``task_events_since``) instead of re-copying the whole event log
+        every 2s; the rolling cache serves the pane."""
+        from ray_tpu.core.runtime import get_runtime
+
+        gcs = get_runtime().gcs
+        with self._ev_lock:
+            cursor = self._ev_cursor
+        # RPC outside the lock: a hung/restarting GCS must not park every
+        # poll (and the shared executor threads) behind one blocked reader.
+        new_cursor, events = gcs.task_events_since(cursor, limit)
+        with self._ev_lock:
+            if self._ev_cursor == cursor:
+                self._ev_cursor = new_cursor
+                for e in events:
+                    self._ev_cache.append(_event_row(e))
+            # else: a concurrent poll already advanced past us — its events
+            # are in the cache; appending ours again would duplicate rows.
+            return list(self._ev_cache)[::-1]
+
+
 def _state():
     from ray_tpu.util import state
 
     return state
 
 
-def _task_event_feed(limit: int = 500):
-    """Most recent task/span events from the GCS task-event store
-    (``gcs_task_manager.cc`` analog), newest first."""
+def _event_row(e: dict) -> dict:
+    return {
+        "ts": e.get("time") or e.get("ts") or "",
+        "kind": e.get("state", e.get("kind", "event")),
+        "name": e.get("name", ""),
+        "task_id": str(e.get("task_id", ""))[-16:],
+        "node": str(e.get("node_id", ""))[:12],
+        "duration": e.get("duration"),
+        "detail": {k: v for k, v in e.items()
+                   if k not in ("time", "ts", "state", "kind", "name",
+                                "task_id", "node_id", "duration")},
+    }
+
+
+def _flush_local_exporter() -> None:
+    """The serving process's own exporter may be mid-interval — flush it so
+    its series are fresh in the merged exposition."""
     from ray_tpu.core.runtime import get_runtime
 
-    events = get_runtime().gcs.task_events()
-    out = []
-    for e in events[-limit:][::-1]:
-        out.append({
-            "ts": e.get("time") or e.get("ts") or "",
-            "kind": e.get("state", e.get("kind", "event")),
-            "name": e.get("name", ""),
-            "task_id": str(e.get("task_id", ""))[-16:],
-            "node": str(e.get("node_id", ""))[:12],
-            "duration": e.get("duration"),
-            "detail": {k: v for k, v in e.items()
-                       if k not in ("time", "ts", "state", "kind", "name",
-                                    "task_id", "node_id", "duration")},
-        })
-    return out
+    exporter = getattr(get_runtime(), "_metrics_exporter", None)
+    if exporter is not None:
+        exporter.flush()
+
+
+def _cluster_metrics_text() -> str:
+    """Merged cluster-wide exposition from the GCS aggregator, falling back
+    to this process's local registry when no runtime is initialized, the
+    GCS is unreachable, or the export pipeline is disabled."""
+    text = ""
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        _flush_local_exporter()
+        text = get_runtime().gcs.metrics_text()
+    except Exception:  # noqa: BLE001 — no runtime / GCS unreachable
+        from ray_tpu.utils.logging import get_logger, log_swallowed
+
+        log_swallowed(get_logger("dashboard"), "cluster metrics read")
+    if text:
+        return text
+    from ray_tpu.util.metrics import prometheus_text
+
+    return prometheus_text()
+
+
+def _metrics_summary() -> dict:
+    from ray_tpu.core.runtime import get_runtime
+
+    _flush_local_exporter()
+    return get_runtime().gcs.metrics_summary()
 
 
 def _node_stats():
@@ -230,7 +293,7 @@ const TABS = {
   Overview: renderOverview, Nodes: renderNodes, Actors: mkTable('/api/actors'),
   Tasks: mkTable('/api/tasks'), Jobs: mkTable('/api/jobs'),
   'Placement groups': mkTable('/api/placement_groups'),
-  Logs: renderLogs, Events: renderEvents,
+  Logs: renderLogs, Events: renderEvents, Metrics: renderMetrics,
 };
 let logCursor = 0, logLines = [];
 let active = 'Overview';
@@ -308,6 +371,20 @@ async function renderEvents(){
     duration: e.duration != null ? e.duration.toFixed(4)+'s' : '-',
     detail: JSON.stringify(e.detail),
   })));
+}
+async function renderMetrics(){
+  const s = await getJSON('/api/metrics_summary');
+  const procs = table((s.processes||[]).map(p => ({
+    node: (p.node_id||'').slice(0,12), component: p.component, pid: p.pid,
+    'age (s)': p.age_s, metrics: p.metrics,
+  })));
+  const mets = table((s.metrics||[]).map(m => ({
+    name: m.name, type: m.type, series: m.series,
+    total: Math.round(m.total*1000)/1000,
+  })));
+  return '<h3>Reporting processes</h3>' + procs +
+    '<h3>Cluster metrics</h3>' + mets +
+    '<p class="muted">raw exposition: <a href="/metrics">/metrics</a></p>';
 }
 async function refresh(){
   setActive();

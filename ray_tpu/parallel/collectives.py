@@ -1451,6 +1451,19 @@ def get_group_stats(group_name: str = "default") -> Dict[str, int]:
     return dict(st) if st else {}
 
 
+def all_group_stats() -> Dict[str, Dict[str, int]]:
+    """:func:`get_group_stats` over every live group in this process — the
+    metrics exporter's collector mirrors these into per-group gauges."""
+    with _groups_lock:
+        items = list(_groups.items())
+    out: Dict[str, Dict[str, int]] = {}
+    for name, state in items:
+        st = getattr(state, "stats", None)
+        if st:
+            out[name] = dict(st)
+    return out
+
+
 def _group(group_name: str) -> _GroupState:
     with _groups_lock:
         state = _groups.get(group_name)

@@ -84,6 +84,11 @@ class _Batcher:
             self._flusher = None
         if not batch:
             return
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 serve_batch_hist)
+
+        if metrics_enabled():
+            serve_batch_hist().observe(len(batch))
         values = [p.value for p in batch]
         try:
             results = (

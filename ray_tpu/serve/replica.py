@@ -66,6 +66,7 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        started = time.monotonic()
         try:
             target = self._resolve_method(method_name)
             result = target(*args, **kwargs)
@@ -82,6 +83,16 @@ class ReplicaActor:
             multiplex.reset_current_model_id(token)
             with self._lock:
                 self._ongoing -= 1
+            self._observe_latency(time.monotonic() - started)
+
+    def _observe_latency(self, elapsed_s: float) -> None:
+        """Per-deployment request latency histogram (metrics plane)."""
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 serve_request_hist)
+
+        if metrics_enabled():
+            serve_request_hist().observe(
+                elapsed_s, {"deployment": self.deployment_name})
 
     def handle_request_streaming(self, method_name: str, *args, **kwargs):
         """Generator method: yields items (streamed via ObjectRefGenerator)."""

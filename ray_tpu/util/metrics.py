@@ -4,16 +4,59 @@ Analog of the reference's ``python/ray/util/metrics.py`` (Cython-backed there,
 process-local registry here) with a Prometheus text exposition endpoint
 (what the reference's metrics agent exports for scrape —
 ``_private/metrics_agent.py:483``).
+
+Cluster pipeline: every process's exporter thread
+(``ray_tpu.core.metrics_export``) snapshots this registry with
+:func:`snapshot_registry` and ships it to the GCS, whose
+:class:`MetricsAggregator` keeps one series store per (node, component, pid)
+with staleness eviction and renders the merged cluster-wide exposition —
+the role of the reference's per-node metrics agent + Prometheus scrape
+(``_private/metrics_agent.py``, ``src/ray/stats/``).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
+import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
+
+# Collector hooks: callables invoked right before a registry snapshot so
+# ad-hoc stats dicts (rpc send counters, store occupancy, collective byte
+# counters) can be mirrored into Gauges without touching their hot paths.
+_collectors: List[Callable[[], None]] = []
+
+
+def register_collector(fn: Callable[[], None]) -> Callable[[], None]:
+    """Register ``fn`` to run before every registry snapshot; returns an
+    unregister callable."""
+    with _registry_lock:
+        _collectors.append(fn)
+
+    def unregister() -> None:
+        with _registry_lock:
+            try:
+                _collectors.remove(fn)
+            except ValueError:
+                pass
+
+    return unregister
+
+
+def run_collectors() -> None:
+    with _registry_lock:
+        fns = list(_collectors)
+    for fn in fns:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — telemetry must never break work
+            from ray_tpu.utils.logging import get_logger, log_swallowed
+
+            log_swallowed(get_logger("metrics"), "registry collector")
 
 
 class Metric:
@@ -46,8 +89,18 @@ class Metric:
             raise ValueError(f"tags {unknown} not in tag_keys {self._tag_keys}")
         return tuple(sorted(merged.items()))
 
+    def tag_key(self, tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+        """Precompute a validated tag key for the ``*_key`` hot-path
+        variants: callers observing the same tag set repeatedly (built-in
+        framework instrumentation) pay the merge/validate/sort once instead
+        of per observation."""
+        return self._tag_tuple(tags)
+
     def _prom_lines(self) -> List[str]:  # pragma: no cover - overridden
         return []
+
+    def _snapshot(self) -> dict:  # pragma: no cover - overridden
+        return {}
 
 
 class Counter(Metric):
@@ -61,6 +114,11 @@ class Counter(Metric):
         with self._lock:
             self._values[self._tag_tuple(tags)] += value
 
+    def inc_key(self, value: float, key: Tuple[Tuple[str, str], ...]):
+        """``inc`` with a key precomputed by :meth:`Metric.tag_key`."""
+        with self._lock:
+            self._values[key] += value
+
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             return self._values.get(self._tag_tuple(tags), 0.0)
@@ -71,6 +129,12 @@ class Counter(Metric):
             for tags, v in self._values.items():
                 out.append(f"{self._name}{_fmt_tags(tags)} {v}")
         return out
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = list(self._values.items())
+        return {"name": self._name, "type": "counter",
+                "desc": self._description, "samples": samples}
 
 
 class Gauge(Metric):
@@ -93,27 +157,37 @@ class Gauge(Metric):
                 out.append(f"{self._name}{_fmt_tags(tags)} {v}")
         return out
 
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = list(self._values.items())
+        return {"name": self._name, "type": "gauge",
+                "desc": self._description, "samples": samples}
+
 
 class Histogram(Metric):
     def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
-        super().__init__(name, description, tag_keys)
+        # Validate BEFORE registering: a raising __init__ after
+        # super().__init__ would leave a half-constructed metric in the
+        # process registry, poisoning every later snapshot/exposition.
         if not boundaries or list(boundaries) != sorted(boundaries):
             raise ValueError("boundaries must be a sorted non-empty sequence")
+        super().__init__(name, description, tag_keys)
         self._bounds = list(boundaries)
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = defaultdict(float)
         self._totals: Dict[Tuple, int] = defaultdict(int)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = self._tag_tuple(tags)
+        self.observe_key(value, self._tag_tuple(tags))
+
+    def observe_key(self, value: float, key: Tuple[Tuple[str, str], ...]):
+        """``observe`` with a key precomputed by :meth:`Metric.tag_key`."""
         with self._lock:
             buckets = self._counts.setdefault(key, [0] * (len(self._bounds) + 1))
-            for i, b in enumerate(self._bounds):
-                if value <= b:
-                    buckets[i] += 1
-                    break
-            else:
-                buckets[-1] += 1
+            # bisect_left: first bound >= value — matches the ``value <= b``
+            # bucketing in O(log n) instead of a linear scan per observation
+            # (values above every bound land in the +Inf bucket at index -1).
+            buckets[bisect.bisect_left(self._bounds, value)] += 1
             self._sums[key] += value
             self._totals[key] += 1
 
@@ -132,11 +206,27 @@ class Histogram(Metric):
                 out.append(f"{self._name}_count{_fmt_tags(key)} {self._totals[key]}")
         return out
 
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = [(key, (list(buckets), self._sums[key],
+                              self._totals[key]))
+                       for key, buckets in self._counts.items()]
+        return {"name": self._name, "type": "histogram",
+                "desc": self._description, "bounds": list(self._bounds),
+                "samples": samples}
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped inside the quoted label value."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
 
 def _fmt_tags(tags: Tuple[Tuple[str, str], ...]) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in tags)
     return "{" + inner + "}"
 
 
@@ -149,3 +239,152 @@ def prometheus_text() -> str:
     for m in metrics:
         lines.extend(m._prom_lines())
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot_registry() -> List[dict]:
+    """Serializable snapshot of every registered metric — the per-tick
+    payload a process's metrics exporter ships to the GCS."""
+    run_collectors()
+    with _registry_lock:
+        metrics = list(_registry)
+    return [m._snapshot() for m in metrics]
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide aggregation (the GCS side of the metrics pipeline)
+# ---------------------------------------------------------------------------
+
+
+def _render_samples(name: str, mtype: str, samples, bounds,
+                    extra: Tuple[Tuple[str, str], ...]) -> List[str]:
+    """Exposition lines for one process's samples of one metric, with the
+    per-process identity labels (``node_id``/``component``/``pid``) merged
+    into each sample's tags (identity labels win on collision)."""
+    out: List[str] = []
+    for tags, val in samples:
+        merged = dict(tags)
+        merged.update(extra)
+        key = tuple(sorted(merged.items()))
+        if mtype == "histogram":
+            buckets, total_sum, total_count = val
+            cum = 0
+            for i, b in enumerate(bounds or []):
+                cum += buckets[i]
+                out.append(f"{name}_bucket"
+                           f"{_fmt_tags(key + (('le', str(b)),))} {cum}")
+            cum += buckets[-1] if buckets else 0
+            out.append(f"{name}_bucket{_fmt_tags(key + (('le', '+Inf'),))} "
+                       f"{cum}")
+            out.append(f"{name}_sum{_fmt_tags(key)} {total_sum}")
+            out.append(f"{name}_count{_fmt_tags(key)} {total_count}")
+        else:
+            out.append(f"{name}{_fmt_tags(key)} {val}")
+    return out
+
+
+class MetricsAggregator:
+    """Per-(node, component, pid) series store with staleness eviction.
+
+    Every process's exporter reports a full registry snapshot each tick;
+    the newest snapshot per process wins. Reports not refreshed within the
+    staleness window (a dead worker, a drained node) are evicted so the
+    merged exposition only shows live processes — the reference gets the
+    same effect from Prometheus dropping stale scrape targets.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (node_id, component, pid) -> (report_time, snapshot)
+        self._reports: Dict[Tuple[str, str, int], Tuple[float, List[dict]]] = {}
+
+    @staticmethod
+    def _staleness_s() -> float:
+        try:
+            from ray_tpu.core.config import config
+
+            interval = config().metrics_export_interval_s
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            interval = 10.0
+        # Three missed exports = dead; floor keeps short test intervals from
+        # evicting a process that is merely between ticks.
+        return max(5.0, 3.0 * interval)
+
+    def report(self, node_id: str, component: str, pid: int,
+               snapshot: List[dict], now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        horizon = now - self._staleness_s()
+        with self._lock:
+            self._reports[(str(node_id), str(component), int(pid))] = (
+                now, list(snapshot))
+            # Evict on write too: a cluster nobody scrapes must not
+            # accumulate dead-process snapshots until the read path runs.
+            for key in [k for k, (ts, _) in self._reports.items()
+                        if ts < horizon]:
+                self._reports.pop(key, None)
+
+    def _live(self, now: Optional[float] = None) -> List[Tuple[Tuple, float, List[dict]]]:
+        now = now if now is not None else time.time()
+        horizon = now - self._staleness_s()
+        with self._lock:
+            for key in [k for k, (ts, _) in self._reports.items()
+                        if ts < horizon]:
+                self._reports.pop(key, None)
+            return [(k, ts, snap) for k, (ts, snap)
+                    in sorted(self._reports.items())]
+
+    def prometheus_text(self, now: Optional[float] = None) -> str:
+        """Merged cluster-wide exposition: every live process's series,
+        labeled with ``node_id``/``component``/``pid``."""
+        live = self._live(now)
+        # name -> (type, [lines]) — one TYPE header per metric name.
+        by_name: Dict[str, Tuple[str, List[str]]] = {}
+        order: List[str] = []
+        for (node_id, component, pid), _ts, snap in live:
+            extra = (("node_id", node_id), ("component", component),
+                     ("pid", str(pid)))
+            for m in snap:
+                name = m.get("name")
+                if not name:
+                    continue
+                ent = by_name.get(name)
+                if ent is None:
+                    ent = (m["type"], [])
+                    by_name[name] = ent
+                    order.append(name)
+                elif ent[0] != m["type"]:
+                    continue  # type skew across versions: keep first seen
+                ent[1].extend(_render_samples(name, m["type"], m["samples"],
+                                              m.get("bounds"), extra))
+        lines: List[str] = []
+        for name in order:
+            mtype, series = by_name[name]
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.extend(series)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """JSON rollup for the dashboard UI: live processes + per-metric
+        series counts and cluster-wide totals."""
+        now = now if now is not None else time.time()
+        live = self._live(now)
+        processes = []
+        metrics: Dict[str, Dict[str, Any]] = {}
+        for (node_id, component, pid), ts, snap in live:
+            processes.append({"node_id": node_id, "component": component,
+                              "pid": pid, "age_s": round(now - ts, 3),
+                              "metrics": len(snap)})
+            for m in snap:
+                name = m.get("name")
+                if not name:
+                    continue
+                ent = metrics.setdefault(
+                    name, {"name": name, "type": m["type"], "series": 0,
+                           "total": 0.0})
+                ent["series"] += len(m["samples"])
+                for _tags, val in m["samples"]:
+                    if m["type"] == "histogram":
+                        ent["total"] += val[2]  # observation count
+                    else:
+                        ent["total"] += val
+        return {"processes": processes,
+                "metrics": sorted(metrics.values(), key=lambda e: e["name"])}

@@ -8,6 +8,7 @@ object-store stats.
 
 from __future__ import annotations
 
+import threading
 from collections import Counter as _Counter
 from typing import Any, Dict, List, Optional
 
@@ -44,18 +45,63 @@ def list_actors(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+# Incremental task index: repeated list_tasks() calls (the dashboard polls
+# every 2s) fold only NEW events via the GCS task_events_since cursor
+# instead of copying the whole (up to 100k-entry) event log per call.
+_tasks_lock = threading.Lock()
+_tasks_cache: Dict[str, Any] = {"gcs": None, "cursor": 0, "latest": {}}
+
+
+def _reset_task_cache() -> None:
+    """Drop the incremental index (called on runtime shutdown so a dead
+    runtime's GCS handle and task rows aren't retained until the next
+    list_tasks under a fresh runtime)."""
+    with _tasks_lock:
+        _tasks_cache["gcs"] = None
+        _tasks_cache["cursor"] = 0
+        _tasks_cache["latest"] = {}
+
+
+def _fold_event(latest: Dict[str, Dict[str, Any]], e: dict) -> None:
+    tid = e.get("task_id")
+    cur = latest.setdefault(tid, {"task_id": tid})
+    cur["name"] = e.get("name", cur.get("name", ""))
+    cur["state"] = e.get("state", cur.get("state", ""))
+    cur["node_id"] = e.get("node_id", cur.get("node_id", ""))
+    if e.get("duration") is not None:
+        cur["duration_s"] = e["duration"]
+
+
 def list_tasks(*, state: Optional[str] = None, limit: int = 10_000) -> List[Dict[str, Any]]:
     rt = get_runtime()
-    latest: Dict[str, Dict[str, Any]] = {}
-    for e in rt.gcs.task_events():
-        tid = e.get("task_id")
-        cur = latest.setdefault(tid, {"task_id": tid})
-        cur["name"] = e.get("name", cur.get("name", ""))
-        cur["state"] = e.get("state", cur.get("state", ""))
-        cur["node_id"] = e.get("node_id", cur.get("node_id", ""))
-        if e.get("duration") is not None:
-            cur["duration_s"] = e["duration"]
-    rows = list(latest.values())
+    gcs = rt.gcs
+    while True:
+        with _tasks_lock:
+            if _tasks_cache["gcs"] is not gcs:
+                # Fresh runtime (or reconnect): rebuild from event 0.
+                _tasks_cache["gcs"] = gcs
+                _tasks_cache["cursor"] = 0
+                _tasks_cache["latest"] = {}
+            cursor = _tasks_cache["cursor"]
+        # The GCS read happens OUTSIDE the lock (it may be a blocking RPC);
+        # results apply only if no concurrent caller advanced the cursor.
+        new_cursor, events = gcs.task_events_since(cursor, 10_000)
+        with _tasks_lock:
+            if _tasks_cache["gcs"] is not gcs:
+                continue  # runtime swapped mid-read: start over
+            if _tasks_cache["cursor"] == cursor:
+                latest = _tasks_cache["latest"]
+                for e in events:
+                    _fold_event(latest, e)
+                # Bound the index like the GCS bounds its event log: the
+                # old rebuild-per-call was implicitly capped at log size.
+                if len(latest) > 100_000:
+                    for tid in list(latest)[: len(latest) // 2]:
+                        del latest[tid]
+                _tasks_cache["cursor"] = new_cursor
+            if len(events) < 10_000:
+                rows = [dict(r) for r in _tasks_cache["latest"].values()]
+                break
     if state is not None:
         rows = [r for r in rows if r.get("state") == state]
     return rows[:limit]

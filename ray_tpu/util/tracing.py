@@ -48,7 +48,10 @@ def span(name: str, *, runtime=None) -> Iterator[Tuple[str, str]]:
     trace_id = parent[0] if parent else _new_id()
     span_id = _new_id()
     set_context((trace_id, span_id))
-    started = time.time()
+    # Duration comes from the monotonic clock (immune to NTP steps /
+    # wall-clock adjustments mid-span); the event timestamp stays wall time
+    # so spans line up with the rest of the task-event stream.
+    started_mono = time.monotonic()
     try:
         yield (trace_id, span_id)
     finally:
@@ -59,7 +62,7 @@ def span(name: str, *, runtime=None) -> Iterator[Tuple[str, str]]:
             "state": "FINISHED",
             "kind": "span",
             "time": time.time(),
-            "duration": time.time() - started,
+            "duration": time.monotonic() - started_mono,
             "trace_id": trace_id,
             "parent_span_id": parent[1] if parent else None,
             "node_id": f"pid-{os.getpid()}",
