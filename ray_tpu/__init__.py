@@ -21,6 +21,15 @@ if _os.environ.get("RAY_TPU_LOCK_ORDER_CHECK_ENABLED", "").lower() in (
 
     _lockcheck.install()
 
+if _os.environ.get("RAY_TPU_LEAK_CHECK_ENABLED", "").lower() in (
+        "1", "true", "yes", "on"):
+    # Same top-of-import rule as lockcheck: threads/fds created while the
+    # submodules below import must already carry allocation-site stamps,
+    # or every import-time acquire shows up site-less in leak reports.
+    from ray_tpu.devtools import leakcheck as _leakcheck
+
+    _leakcheck.install()
+
 from ray_tpu._version import version as __version__
 from ray_tpu.api import (
     available_resources,

@@ -107,15 +107,28 @@ class Cluster:
 
     # -- fault injection (test_utils.py kill_raylet analog) -------------------
 
+    @staticmethod
+    def _close_pipe(proc: subprocess.Popen) -> None:
+        """Close our end of a dead child's stdout pipe — the parent holds
+        one fd per spawned process otherwise (GC closes it eventually, but
+        chaos tests churn dozens of processes per run)."""
+        if proc.stdout is not None:
+            try:
+                proc.stdout.close()
+            except OSError:
+                pass
+
     def kill_node(self, index: int, sig: int = signal.SIGKILL) -> NodeHandle:
         handle = self.nodes[index]
         handle.proc.send_signal(sig)
         handle.proc.wait(timeout=10)
+        self._close_pipe(handle.proc)
         return handle
 
     def kill_gcs(self, sig: int = signal.SIGKILL) -> None:
         self.gcs_proc.send_signal(sig)
         self.gcs_proc.wait(timeout=10)
+        self._close_pipe(self.gcs_proc)
 
     def restart_gcs(self, restore_from: str | None = None) -> None:
         """Head restart: rebuild tables from the snapshot (GCS FT path —
@@ -164,6 +177,7 @@ class Cluster:
                 proc.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 proc.kill()
+            self._close_pipe(proc)
         # SIGKILLed daemons can't unlink their shm arenas; sweep them here
         # so chaos tests don't leak /dev/shm across runs.
         for handle in self.nodes:

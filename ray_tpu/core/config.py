@@ -213,6 +213,11 @@ class Config:
     # graph, and raise LockOrderError on an inversion. Dev/test only — adds
     # per-acquire bookkeeping to every lock in the process.
     lock_order_check_enabled = _Flag(False)
+    # Opt-in runtime leak validator (ray_tpu.devtools.leakcheck): threads,
+    # os.open/os.pipe fds and sockets are stamped with their allocation
+    # site; the test harness snapshots live threads/fds/shm segments per
+    # test and fails on anything that survives teardown. Dev/test only.
+    leak_check_enabled = _Flag(False)
 
     # -- TPU ------------------------------------------------------------------
     # Logical chips per host for resource autodetection when no TPU present

@@ -1039,9 +1039,14 @@ class CoreWorker:
     def _ensure_borrower_sweeper(self) -> None:
         if self._borrow_sweeper_started:
             return
+        # Event + thread handle BEFORE the flag: shutdown() keys on the
+        # flag and would AttributeError on a half-published sweeper.
+        self._borrow_sweep_stop = threading.Event()
+        self._borrow_sweeper = threading.Thread(
+            target=self._sweep_dead_borrowers, name="borrow-sweeper",
+            daemon=True)
         self._borrow_sweeper_started = True
-        threading.Thread(target=self._sweep_dead_borrowers,
-                         name="borrow-sweeper", daemon=True).start()
+        self._borrow_sweeper.start()
 
     # Failed-ping strikes before a borrower is purged: fast when nothing is
     # listening on its port (process is gone), slow when a listener exists
@@ -1078,8 +1083,9 @@ class CoreWorker:
         by a raw listener probe so an alive-but-unresponsive borrower keeps
         its borrows)."""
         strikes: Dict[str, int] = {}
-        while not self._shutdown:
-            time.sleep(5.0)
+        # Event-paced (not time.sleep) so shutdown can cut the 5s nap
+        # short and actually join this thread.
+        while not self._borrow_sweep_stop.wait(5.0) and not self._shutdown:
             addrs = self.reference_counter.borrower_addrs()
             for addr in list(strikes):
                 if addr not in addrs:
@@ -3134,14 +3140,27 @@ class CoreWorker:
         sink = sink or (lambda entry, line: print(
             f"({entry['worker']}, node {entry['node_id'][:8]}) {line}"))
 
+        # Client owned by self (not the loop) so shutdown can close it and
+        # abort a parked long-poll instead of abandoning the thread to its
+        # 30s RPC timeout.
+        self._log_client = RpcClient(self.gcs_address)
+
         def poll_loop():
             cursor = 0
-            client = RpcClient(self.gcs_address)
+            client = self._log_client
             while not self._shutdown:
                 try:
                     cursor, messages = client.call(
                         "poll_channel", "logs", cursor, 10.0, timeout=30.0)
                 except (RpcConnectionError, TimeoutError):
+                    if self._shutdown:
+                        break
+                    time.sleep(1.0)
+                    continue
+                except Exception:  # noqa: BLE001 — e.g. closed mid-shutdown
+                    if self._shutdown:
+                        break
+                    log_swallowed(logger, "log-mirror poll")
                     time.sleep(1.0)
                     continue
                 for batch in messages:
@@ -3162,6 +3181,20 @@ class CoreWorker:
     def shutdown(self) -> None:
         self._shutdown = True
         self._metrics_exporter.stop()
+        # Abort the log-mirror's parked long-poll (closing the client
+        # errors the in-flight call) and join the thread.
+        log_client = getattr(self, "_log_client", None)
+        if log_client is not None:
+            try:
+                log_client.close()
+            except Exception:  # noqa: BLE001 — already closed/errored
+                log_swallowed(logger, "log client close at shutdown")
+        log_thread = getattr(self, "_log_thread", None)
+        if log_thread is not None:
+            log_thread.join(timeout=2.0)
+        if self._borrow_sweeper_started:
+            self._borrow_sweep_stop.set()
+            self._borrow_sweeper.join(timeout=2.0)
         # Flush __del__-deferred releases while the owner/GCS connections
         # are still open (deregistrations and frees ride RPCs).
         self._ref_release_stop.set()

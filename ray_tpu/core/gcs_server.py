@@ -1212,6 +1212,9 @@ def main(argv=None) -> int:
     from ray_tpu.devtools.lockcheck import maybe_install
 
     maybe_install()  # lock_order_check_enabled: instrument before any locks
+    from ray_tpu.devtools.leakcheck import maybe_install as _leak_install
+
+    _leak_install()  # leak_check_enabled: stamp allocation sites early
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")

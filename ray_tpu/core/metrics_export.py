@@ -253,9 +253,19 @@ class MetricsExporter:
             log_swallowed(logger, "metrics export tick")
 
     def stop(self) -> None:
+        """Join the exporter thread (with timeout) rather than abandoning
+        it as a daemon: an abandoned exporter holds its GCS client and one
+        report slot per restart cycle. Idempotent."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2.0)
+            if thread.is_alive():
+                # Mid-flush on an unresponsive GCS: the RPC timeout will
+                # reap it; don't race a second flush from this thread.
+                logger.warning("metrics exporter did not stop in 2s "
+                               "(flush in flight); skipping final flush")
+                return
             # Final flush: ship the last partial interval's observations
             # (runs on the caller, after the loop thread is parked/joined).
             self.flush()

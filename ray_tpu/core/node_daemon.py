@@ -265,6 +265,17 @@ class NodeDaemon:
                 self._shm.destroy()
             except Exception:  # noqa: BLE001
                 log_swallowed(logger, "shm store destroy at shutdown")
+        # Close the spill-chunk pread fd cache: the spill files are about
+        # to be rmtree'd and a daemon that restarts in-process (tests,
+        # supervised respawn) must not accumulate dead fds.
+        with self._spill_fd_lock:
+            spill_fds = list(self._spill_fds.values())
+            self._spill_fds.clear()
+        for fd in spill_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         import shutil
 
         shutil.rmtree(self._log_dir, ignore_errors=True)
@@ -1466,6 +1477,9 @@ def main(argv=None) -> int:
     from ray_tpu.devtools.lockcheck import maybe_install
 
     maybe_install()  # lock_order_check_enabled: instrument before any locks
+    from ray_tpu.devtools.leakcheck import maybe_install as _leak_install
+
+    _leak_install()  # leak_check_enabled: stamp allocation sites early
     import faulthandler
 
     try:

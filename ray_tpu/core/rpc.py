@@ -790,6 +790,15 @@ class RpcServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        # shutdown() BEFORE close(): close() alone frees the fd but does
+        # NOT wake a thread already parked in accept()/recv() on it — the
+        # accept thread would survive every server stop (and could even
+        # accept on a recycled fd number). shutdown() forces those calls
+        # to return with an error first.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -797,10 +806,15 @@ class RpcServer:
         with self._conns_lock:
             for conn in list(self._conns):
                 try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     conn.close()
                 except OSError:
                     pass
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._accept_thread.join(timeout=2.0)
 
 
 # Sentinel: a registered reply destination that the read loop has filled.
@@ -954,6 +968,13 @@ class RpcClient:
             self._sent_templates = set()
             sender, self._sender = self._sender, None
             if self._sock is not None:
+                # shutdown() first: close() does not wake the reader
+                # thread parked in recv() on this socket — it would leak
+                # (with its fd) on every client close.
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     self._sock.close()
                 except OSError:

@@ -227,7 +227,11 @@ class ActorRunner:
             t.start()
             self._threads.append(t)
             # Drain calls that queued while creation was in flight.
-            asyncio.run_coroutine_threadsafe(self._pump_async(), self._loop)
+            try:
+                asyncio.run_coroutine_threadsafe(self._pump_async(),
+                                                 self._loop)
+            except RuntimeError:
+                pass  # kill() raced creation and already closed the loop
         else:
             for i in range(self.max_concurrency):
                 t = threading.Thread(target=self._sync_main, daemon=True, name=f"actor-{self.actor_id.hex()[:8]}-{i}")
@@ -253,7 +257,23 @@ class ActorRunner:
         if self.is_async and self._loop is not None:
             import asyncio
 
-            asyncio.run_coroutine_threadsafe(self._pump_async(), self._loop)
+            try:
+                asyncio.run_coroutine_threadsafe(self._pump_async(),
+                                                 self._loop)
+            except RuntimeError:
+                # kill() closed the loop between our dead-check and here:
+                # surface the actor death, not the internal loop state.
+                with self.lock:
+                    self.num_pending -= 1
+                    try:
+                        self.mailbox.remove(state)
+                    except ValueError:
+                        # kill() already drained this state and propagated
+                        # its error — raising here would store the error a
+                        # second time.
+                        return
+                raise ActorDiedError(
+                    self.actor_id, str(self.death_error or "actor is dead"))
 
     def _sync_main(self) -> None:
         while True:
@@ -281,6 +301,13 @@ class ActorRunner:
         self.runtime._ctx.node_id = self.node_id
         asyncio.set_event_loop(self._loop)
         self._loop.run_forever()
+        # kill() stopped the loop: release its self-pipe/epoll fds here on
+        # the owning thread (in-flight coroutines are abandoned — that is
+        # the kill semantic).
+        try:
+            self._loop.close()
+        except Exception:  # noqa: BLE001 — a resumed callback mid-close
+            log_swallowed(logger, "async actor loop close")
 
     async def _pump_async(self) -> None:
         import asyncio
@@ -314,7 +341,12 @@ class ActorRunner:
             self.mailbox.clear()
             self.cv.notify_all()
         if self.is_async and self._loop is not None:
-            self._loop.call_soon_threadsafe(lambda: None)
+            # Stop (not just wake) the loop: a dead actor's loop thread
+            # parked in run_forever leaks with its self-pipe fds.
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass  # loop already closed
         return drained
 
 

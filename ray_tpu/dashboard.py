@@ -12,22 +12,42 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from collections import deque
-from typing import Optional
+from typing import Dict, Optional
+
+
+class _EvFeed:
+    """Per-client incremental task-event state: the GCS cursor this client
+    has consumed up to, plus the rolling cache serving its pane."""
+
+    __slots__ = ("cursor", "cache", "last_seen")
+
+    def __init__(self):
+        self.cursor: Optional[int] = None
+        self.cache: deque = deque(maxlen=500)
+        self.last_seen = time.monotonic()
 
 
 class Dashboard:
+    #: per-client event-feed bounds: browsers don't announce disconnects,
+    #: so a client is "gone" when it hasn't polled for the TTL (the UI
+    #: polls every 2s); the cap bounds worst-case memory against id churn.
+    _EV_CLIENT_CAP = 32
+    _EV_CLIENT_TTL_S = 60.0
+
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         self.host = host
         self.port = port
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
-        # Cursor'd task-event feed: each /api/events poll fetches only NEW
-        # events past this cursor; the rolling cache serves the pane.
+        # Cursor'd task-event feed, PER CLIENT (each browser tab passes a
+        # random ?client= id): each poll fetches only events past that
+        # client's cursor. Bounded + stale-evicted — an id-churning or
+        # vanished client must not pin cursor/cache entries forever.
         self._ev_lock = threading.Lock()
-        self._ev_cursor: Optional[int] = None
-        self._ev_cache: deque = deque(maxlen=500)
+        self._ev_clients: Dict[str, _EvFeed] = {}
 
     def start(self) -> "Dashboard":
         self._thread = threading.Thread(target=self._serve, daemon=True)
@@ -69,7 +89,7 @@ class Dashboard:
         # dashboard/modules/log/log_manager.py, modules/event/) over the
         # existing GCS log aggregation and task-event pipeline.
         app.router.add_get("/api/logs", self._logs)
-        app.router.add_get("/api/events", self._json(self._task_event_feed))
+        app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/metrics_summary",
                            self._json(_metrics_summary))
         app.router.add_get("/metrics", self._metrics)
@@ -84,7 +104,9 @@ class Dashboard:
             loop.run_forever()
         finally:
             loop.run_until_complete(runner.cleanup())
-            loop.close()
+            from ray_tpu.utils.eventloop import drain_and_close_loop
+
+            drain_and_close_loop(loop, "dashboard")
 
     def _summary(self):
         return _state().cluster_summary()
@@ -157,29 +179,60 @@ class Dashboard:
         return web.Response(text=_INDEX_HTML, content_type="text/html")
 
 
-    def _task_event_feed(self, limit: int = 500):
+    async def _events(self, request):
+        from aiohttp import web
+
+        client = request.query.get("client", "")
+        loop = asyncio.get_event_loop()
+        data = await loop.run_in_executor(
+            None, lambda: self._task_event_feed(client))
+        return web.Response(text=json.dumps(data, default=str),
+                            content_type="application/json")
+
+    def _ev_state(self, client: str) -> _EvFeed:
+        """Look up (or create) one client's feed state; evict the stale and
+        the over-cap while here. Caller holds ``_ev_lock``."""
+        now = time.monotonic()
+        st = self._ev_clients.get(client)
+        if st is None:
+            st = self._ev_clients[client] = _EvFeed()
+        st.last_seen = now
+        dead = [k for k, v in self._ev_clients.items()
+                if k != client and now - v.last_seen > self._EV_CLIENT_TTL_S]
+        for k in dead:
+            del self._ev_clients[k]
+        while len(self._ev_clients) > self._EV_CLIENT_CAP:
+            oldest = min((k for k in self._ev_clients if k != client),
+                         key=lambda k: self._ev_clients[k].last_seen)
+            del self._ev_clients[oldest]
+        return st
+
+    def _task_event_feed(self, client: str = "", limit: int = 500):
         """Most recent task/span events from the GCS task-event store
         (``gcs_task_manager.cc`` analog), newest first.
 
-        Incremental: each poll ships only events past the stored cursor
-        (``task_events_since``) instead of re-copying the whole event log
-        every 2s; the rolling cache serves the pane."""
+        Incremental PER CLIENT: each poll ships only events past that
+        client's cursor (``task_events_since``) instead of re-copying the
+        whole event log every 2s; the client's rolling cache serves its
+        pane (two tabs no longer race one shared cursor)."""
         from ray_tpu.core.runtime import get_runtime
 
         gcs = get_runtime().gcs
         with self._ev_lock:
-            cursor = self._ev_cursor
+            st = self._ev_state(client)
+            cursor = st.cursor
         # RPC outside the lock: a hung/restarting GCS must not park every
         # poll (and the shared executor threads) behind one blocked reader.
         new_cursor, events = gcs.task_events_since(cursor, limit)
         with self._ev_lock:
-            if self._ev_cursor == cursor:
-                self._ev_cursor = new_cursor
+            if st.cursor == cursor:
+                st.cursor = new_cursor
                 for e in events:
-                    self._ev_cache.append(_event_row(e))
-            # else: a concurrent poll already advanced past us — its events
-            # are in the cache; appending ours again would duplicate rows.
-            return list(self._ev_cache)[::-1]
+                    st.cache.append(_event_row(e))
+            # else: a concurrent poll of the SAME client id already
+            # advanced past us — its events are in the cache; appending
+            # ours again would duplicate rows.
+            return list(st.cache)[::-1]
 
 
 def _state():
@@ -296,6 +349,7 @@ const TABS = {
   Logs: renderLogs, Events: renderEvents, Metrics: renderMetrics,
 };
 let logCursor = 0, logLines = [];
+const clientId = Math.random().toString(36).slice(2);
 let active = 'Overview';
 const nav = document.getElementById('nav');
 Object.keys(TABS).forEach(name => {
@@ -364,7 +418,7 @@ async function renderLogs(){
                      : '(no worker log lines yet)') + '</pre>';
 }
 async function renderEvents(){
-  const evs = await getJSON('/api/events');
+  const evs = await getJSON('/api/events?client=' + clientId);
   return table(evs.map(e => ({
     ts: e.ts, kind: e.kind, name: e.name, task: e.task_id,
     node: e.node,

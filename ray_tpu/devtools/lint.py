@@ -39,6 +39,41 @@ Checks
     Every ``cfg.<name>`` / ``config().<name>`` access must resolve to a
     declared ``_Flag``; every declared ``_Flag`` must be referenced
     somewhere and carry a doc comment.
+``rpc-cycle``
+    Cross-process wait-cycle analysis. The rpc-surface pass already knows
+    which service class each string-dispatched client call lands on; this
+    check lifts those edges to the INTER-process call graph — nodes are
+    ``Service.handler`` methods, an edge means "while serving this handler
+    the process issues a blocking ``.call`` that the target service's
+    handler serves" (interprocedural through ``self`` calls, like the
+    lock-order pass). Flagged:
+
+    - handler→handler cycles: A's handler blocks on an RPC whose serving
+      handler can call back into A — when both sides serve synchronously
+      this is a distributed deadlock (each process is parked in ``.call``
+      waiting for the other's reply);
+    - blocking RPC edges issued while holding a lock, when that edge
+      participates in such a cycle OR the remote handler chain can RPC
+      back into a method of the caller's class that needs the held lock
+      (the per-class lock graph composed with the RPC edges).
+
+    One-way ``notify`` / ``call_async`` dispatches don't park the caller
+    and do not create wait edges.
+``thread-leak``
+    Every ``threading.Thread(...)`` must either be daemonized
+    (``daemon=True`` at the ctor or ``t.daemon = True`` before start) or
+    have a reachable ``join()``: for ``self._t``-stored threads a join in
+    a method reachable from a shutdown-path entry point (``close`` /
+    ``shutdown`` / ``stop`` / ``__exit__`` / ...); for function-local
+    threads a join in the same function. A non-daemon thread with no
+    reachable join outlives its owner and wedges interpreter exit.
+``resource-leak``
+    Every OS-resource acquire site stored on the owner — sockets, mmaps,
+    ``os.open`` fds (including dict fd-caches), shm segments /
+    ``NativeObjectStore`` handles — must have a release (``close`` /
+    ``destroy`` / ``unlink`` / ``os.close``) reachable from a
+    shutdown-path method, or be ``with``-managed. Function-local sockets/
+    fds/mmaps that neither escape nor close in-function are flagged too.
 
 Baseline workflow
 =================
@@ -52,8 +87,10 @@ rewritten baseline automatically.
 Usage::
 
     python -m ray_tpu.devtools.lint                 # whole tree vs baseline
+    python -m ray_tpu.devtools.lint --check-baseline  # same, explicit (CI)
     python -m ray_tpu.devtools.lint --update-baseline
     python -m ray_tpu.devtools.lint --no-baseline path/  # raw findings
+    python -m ray_tpu.devtools.lint --profile       # per-check wall time
 """
 
 from __future__ import annotations
@@ -62,6 +99,7 @@ import argparse
 import ast
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -104,6 +142,19 @@ _CLIENT_TABLE: List[Tuple[str, str]] = [
 #: config attribute accesses that are API, not knobs
 _CONFIG_NON_FLAGS = {"to_dict"}
 
+#: method names that read as "this is a shutdown path" for the lifecycle
+#: checks: joins/releases reachable from one of these count as reachable.
+_SHUTDOWN_ENTRY_NAMES = {"close", "shutdown", "stop", "join", "destroy",
+                         "disconnect", "teardown", "terminate", "kill",
+                         "cleanup", "clear", "drain", "release", "reset",
+                         "__exit__", "__del__", "__aexit__", "close_all",
+                         "uninstall", "abort"}
+
+#: method names that release the resource they're called on
+_RELEASE_METHODS = {"close", "shutdown", "unlink", "destroy", "release",
+                    "terminate", "stop", "detach", "munmap", "closerange",
+                    "close_all"}
+
 
 @dataclass
 class Finding:
@@ -120,6 +171,17 @@ class Finding:
                 f"{self.message}")
 
 
+@dataclass(frozen=True)
+class _RpcSite:
+    """One string-dispatched client call observed inside a method body."""
+    recv: str  # receiver expression text (client lookup chain)
+    method: str  # dispatched RPC method name
+    kind: str  # 'call' | 'call_async' | 'notify'
+    held: Optional[str]  # canonical lock token held at the site, if any
+    line: int
+    via: str  # self-call chain from the summarized method to the site
+
+
 @dataclass
 class _MethodSummary:
     """What one method does with locks, for the interprocedural pass."""
@@ -129,6 +191,27 @@ class _MethodSummary:
     nested: List[Tuple[str, str, int]] = field(default_factory=list)
     # self-calls made while holding a lock: (held, callee, line)
     held_calls: List[Tuple[str, str, int]] = field(default_factory=list)
+    # string-dispatched RPC client calls made directly in this method
+    rpc_calls: List[_RpcSite] = field(default_factory=list)
+
+
+@dataclass
+class _ThreadSite:
+    """One ``threading.Thread(...)`` construction stored on the owner."""
+    attr: str  # self attribute the thread is assigned to
+    line: int
+    scope: str
+    daemon: bool  # daemon=True at the ctor
+
+
+@dataclass
+class _ResourceSite:
+    """One OS-resource acquire assigned to an owner attribute."""
+    attr: str
+    line: int
+    scope: str
+    kind: str  # 'socket' | 'fd' | 'mmap' | 'shm' | 'file'
+    is_dict: bool  # acquired into self.attr[key] (an fd/handle cache)
 
 
 @dataclass
@@ -140,6 +223,19 @@ class _ClassInfo:
     cond_alias: Dict[str, str] = field(default_factory=dict)  # cond -> lock
     methods: Dict[str, _MethodSummary] = field(default_factory=dict)
     public_methods: Set[str] = field(default_factory=set)
+    # lifecycle bookkeeping (thread-leak / resource-leak)
+    thread_sites: List[_ThreadSite] = field(default_factory=list)
+    resource_sites: List[_ResourceSite] = field(default_factory=list)
+    # method -> thread attrs it joins / resource attrs it releases
+    joins: Dict[str, Set[str]] = field(default_factory=dict)
+    releases: Dict[str, Set[str]] = field(default_factory=dict)
+    daemon_attrs: Set[str] = field(default_factory=set)  # self.X.daemon=True
+    # coarse release evidence: methods containing ANY close-ish call, and
+    # every self attr each method references (release of a dict fd-cache
+    # rarely names `self._fds.close()` — it pops entries and os.close's
+    # the values, so "mentions the attr + closes something" must count)
+    release_methods: Set[str] = field(default_factory=set)
+    method_refs: Dict[str, Set[str]] = field(default_factory=dict)
 
 
 def _is_threading_ctor(node: ast.expr) -> Optional[str]:
@@ -187,6 +283,67 @@ def _lockish(node: ast.expr) -> bool:
         return False
     low = name.lower()
     return low in _LOCKISH_NAMES or low.endswith(_LOCKISH_SUFFIXES)
+
+
+def _assign_targets(stmt: ast.stmt) -> List[Tuple[ast.expr, ast.expr]]:
+    """(target, value) pairs for plain, annotated, and chained assignments
+    — `self._t: Thread = Thread(...)` and `self.a = self.b = ctor()` must
+    be visible to the lifecycle checks like any other acquire."""
+    if isinstance(stmt, ast.Assign):
+        return [(t, stmt.value) for t in stmt.targets]
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return [(stmt.target, stmt.value)]
+    return []
+
+
+def _is_thread_ctor(node: ast.expr) -> Optional[bool]:
+    """Whether daemon=True was passed at a Thread construction. None when
+    the node is not a Thread ctor — or when ``daemon=`` is a non-constant
+    expression (statically unknown: skip rather than flag a thread that
+    may well be daemonized at runtime)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if name != "Thread":
+        return None
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return None
+    return False
+
+
+def _resource_ctor(node: ast.expr) -> Optional[str]:
+    """Resource kind ('socket'|'fd'|'mmap'|'shm'|'file') if the expression
+    acquires an OS resource needing an explicit release, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        recv, attr = fn.value.id, fn.attr
+        if recv == "socket" and attr in ("socket", "create_connection",
+                                         "create_server", "socketpair"):
+            return "socket"
+        if recv == "mmap" and attr == "mmap":
+            return "mmap"
+        if recv == "os" and attr in ("open", "fdopen", "dup",
+                                     "memfd_create", "eventfd"):
+            return "fd"
+        if attr == "SharedMemory":
+            return "shm"
+        if recv == "NativeObjectStore" and attr == "open":
+            return "shm"
+    elif isinstance(fn, ast.Name):
+        if fn.id == "SharedMemory":
+            return "shm"
+        if fn.id == "NativeObjectStore":
+            return "shm"
+        if fn.id == "open":
+            return "file"
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -352,13 +509,18 @@ class _FunctionWalker:
                 self.summary.held_calls.append(
                     (self.held[-1], callee, call.lineno))
 
-        # RPC dispatch surface
+        # RPC dispatch surface (+ wait-cycle edge bookkeeping)
         if fn_name in _DISPATCH_METHODS and recv is not None and call.args:
             arg0 = call.args[0]
             if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
                 self.linter.rpc_sites.append(
                     (self.path, call.lineno, self.scope,
                      _expr_text(recv), arg0.value))
+                held = next((h for h in reversed(self.held)
+                             if not h.startswith("?")), None)
+                self.summary.rpc_calls.append(_RpcSite(
+                    _expr_text(recv), arg0.value, fn_name, held,
+                    call.lineno, self.scope))
 
         # untimed waits (held or not)
         self._untimed(call, fn_name, recv)
@@ -438,9 +600,29 @@ class _FunctionWalker:
 # ---------------------------------------------------------------------------
 
 
+#: (abspath) -> (stat key, parsed tree, source) — shared across Linter
+#: instances (each check family used to re-read and re-parse the tree; the
+#: tests alone construct dozens of Linters over the same files)
+_AST_CACHE: Dict[str, Tuple[Tuple[int, int], ast.Module, str]] = {}
+
+
+def _parse_cached(path: str) -> Tuple[ast.Module, str]:
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _AST_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1], hit[2]
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    _AST_CACHE[path] = (key, tree, src)
+    return tree, src
+
+
 class Linter:
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
+        self.timings: Dict[str, float] = {}
         self.findings: List[Finding] = []
         # (path, line, scope, receiver_text, method_name)
         self.rpc_sites: List[Tuple[str, int, str, str, str]] = []
@@ -486,15 +668,20 @@ class Linter:
 
     # -- scan ---------------------------------------------------------------
 
+    def _timed(self, phase: str, fn) -> None:
+        t0 = time.perf_counter()
+        fn()
+        self.timings[phase] = self.timings.get(phase, 0.0) \
+            + time.perf_counter() - t0
+
     def run(self) -> List[Finding]:
+        t0 = time.perf_counter()
         files = self._collect_files()
         parsed: List[Tuple[str, ast.Module, str]] = []
         for path in files:
             rel = os.path.relpath(path, self.root).replace(os.sep, "/")
             try:
-                with open(path, "r", encoding="utf-8") as fh:
-                    src = fh.read()
-                tree = ast.parse(src, filename=path)
+                tree, src = _parse_cached(path)
             except (SyntaxError, UnicodeDecodeError, OSError) as e:
                 self.add(Finding("parse-error", rel, getattr(e, "lineno", 0)
                                  or 0, "<file>", f"cannot parse: {e}",
@@ -502,16 +689,29 @@ class Linter:
                 continue
             parsed.append((rel, tree, src))
             self.src_lines[rel] = src.splitlines()
+        self.timings["parse"] = time.perf_counter() - t0
 
-        for rel, tree, src in parsed:
-            self._scan_config_decls(rel, tree, src)
-        for rel, tree, src in parsed:
-            self._scan_module(rel, tree)
-        self._check_lock_order()
-        self._check_rpc_surface()
-        self._check_config_knobs()
+        def scan():
+            for rel, tree, src in parsed:
+                self._scan_config_decls(rel, tree, src)
+            for rel, tree, _src in parsed:
+                self._scan_module(rel, tree)
+
+        # The per-file scan feeds every check from the cached ASTs in two
+        # traversals per function (the lock walker + one lifecycle
+        # bucketing walk); inline checks — blocking-under-lock,
+        # untimed-wait, swallowed-exception, local lifecycle leaks — fire
+        # during it, the graph checks below reuse its summaries.
+        self._timed("scan", scan)
+        self._timed("lock-order", self._check_lock_order)
+        self._timed("rpc-surface", self._check_rpc_surface)
+        self._timed("rpc-cycle", self._check_rpc_cycle)
+        self._timed("thread-leak", self._check_thread_leaks)
+        self._timed("resource-leak", self._check_resource_leaks)
+        self._timed("config-knob", self._check_config_knobs)
         self._assign_fingerprints()
         self.findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+        self.timings["total"] = time.perf_counter() - t0
         return self.findings
 
     def _collect_files(self) -> List[str]:
@@ -593,6 +793,7 @@ class Linter:
                     walker = _FunctionWalker(self, rel, info, scope,
                                              info.methods[item.name])
                     walker.walk(item.body)
+                    self._scan_fn_lifecycle(rel, info, item.name, scope, item)
             # service discovery: RpcServer(self, ...) inside the class
             for sub in ast.walk(cls_node):
                 if (isinstance(sub, ast.Call)
@@ -610,6 +811,7 @@ class Linter:
                 walker = _FunctionWalker(self, rel, mod, scope,
                                          mod.methods[node.name])
                 walker.walk(node.body)
+                self._scan_fn_lifecycle(rel, mod, node.name, scope, node)
 
         # service discovery: RpcServer(<Name or Call>, ...) anywhere
         by_name = {c.name: c for c in self.classes if c.path == rel}
@@ -651,24 +853,200 @@ class Linter:
                 if wrapped in info.locks:
                     info.locks[attr] = info.locks[wrapped]
 
+    # -- lifecycle scan (thread-leak / resource-leak raw material) -----------
+
+    def _scan_fn_lifecycle(self, rel: str, info: _ClassInfo, name: str,
+                           scope: str, fn: ast.AST) -> None:
+        """Collect thread/resource acquire, join, daemonize and release
+        evidence from one function body (class method or module function),
+        and flag function-LOCAL leaks immediately."""
+        local_threads: Dict[str, Dict] = {}  # var -> {daemon, joined, line}
+        local_res: Dict[str, Dict] = {}  # var -> {kind, line, closed}
+        escaped: Set[str] = set()
+        refs: Set[str] = set()
+
+        # ONE traversal buckets everything the passes below need: with-
+        # managed context ids, (target, value) assignment pairs, calls,
+        # self-attr reads, and returned/yielded expressions.
+        with_ctxs: Set[int] = set()
+        assigns: List[Tuple[ast.expr, ast.expr, int]] = []
+        calls: List[ast.Call] = []
+        escape_exprs: List[ast.expr] = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    with_ctxs.add(id(item.context_expr))
+            elif isinstance(sub, ast.Call):
+                calls.append(sub)
+            elif isinstance(sub, ast.Attribute):
+                a = _self_attr(sub)
+                if a is not None:
+                    refs.add(a)
+            elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if sub.value is not None:
+                    escape_exprs.append(sub.value)
+            for tgt, val in _assign_targets(sub):
+                assigns.append((tgt, val, sub.lineno))
+
+        # pass 1: direct constructions
+        for tgt, val, lineno in assigns:
+            if id(val) in with_ctxs:
+                continue
+            daemon = _is_thread_ctor(val)
+            kind = _resource_ctor(val) if daemon is None else None
+            if daemon is None and kind is None:
+                continue
+            attr = _self_attr(tgt)
+            sub_attr = (_self_attr(tgt.value)
+                        if isinstance(tgt, ast.Subscript) else None)
+            if daemon is not None:
+                if attr is not None:
+                    info.thread_sites.append(
+                        _ThreadSite(attr, lineno, scope, daemon))
+                elif isinstance(tgt, ast.Name):
+                    local_threads[tgt.id] = {"daemon": daemon, "joined": False,
+                                             "line": lineno}
+            else:
+                if attr is not None:
+                    info.resource_sites.append(_ResourceSite(
+                        attr, lineno, scope, kind, is_dict=False))
+                elif sub_attr is not None:
+                    info.resource_sites.append(_ResourceSite(
+                        sub_attr, lineno, scope, kind, is_dict=True))
+                elif isinstance(tgt, ast.Name) and kind != "file":
+                    # plain local `open()` file handles are everywhere and
+                    # usually short-lived; flag only kernel-object locals
+                    local_res[tgt.id] = {"kind": kind, "line": lineno,
+                                         "closed": False}
+        # pass 2a: stores of tracked locals onto self + daemonization
+        for tgt, val, _lineno in assigns:
+            # self.X = t / self.X[k] = fd promotes a local to an attr site
+            if isinstance(val, ast.Name):
+                attr = _self_attr(tgt)
+                sub_attr = (_self_attr(tgt.value)
+                            if isinstance(tgt, ast.Subscript) else None)
+                if val.id in local_threads and attr is not None:
+                    t = local_threads.pop(val.id)
+                    info.thread_sites.append(
+                        _ThreadSite(attr, t["line"], scope, t["daemon"]))
+                elif val.id in local_res and (attr is not None
+                                              or sub_attr is not None):
+                    r = local_res.pop(val.id)
+                    info.resource_sites.append(_ResourceSite(
+                        attr or sub_attr, r["line"], scope, r["kind"],
+                        is_dict=attr is None))
+                elif val.id in local_threads or val.id in local_res:
+                    escaped.add(val.id)  # aliased somewhere we can't see
+            # t.daemon = True / self.X.daemon = True
+            if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                    and isinstance(val, ast.Constant) and val.value):
+                inner = tgt.value
+                a = _self_attr(inner)
+                if a is not None:
+                    info.daemon_attrs.add(a)
+                elif isinstance(inner, ast.Name) and \
+                        inner.id in local_threads:
+                    local_threads[inner.id]["daemon"] = True
+        # pass 2b: joins, releases, escapes through calls
+        for call in calls:
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                recv = f.value
+                recv_attr = _self_attr(recv)
+                recv_name = recv.id if isinstance(recv, ast.Name) else None
+                # `Thread(...).start()` never bound anywhere: joinable by
+                # nobody — must be a daemon
+                if f.attr == "start" and _is_thread_ctor(recv) is False:
+                    self.add(Finding(
+                        "thread-leak", rel, call.lineno, scope,
+                        "anonymous non-daemon `Thread(...).start()` — no "
+                        "reference survives to join it; pass daemon=True "
+                        "or keep a handle and join on shutdown",
+                        "anonymous-thread"))
+                # os.close/os.closerange BEFORE the generic release branch
+                # ("close" is in _RELEASE_METHODS): the released object is
+                # the ARGUMENT here, not the receiver
+                if recv_name == "os" and f.attr in ("close", "closerange"):
+                    info.release_methods.add(name)
+                    for arg in call.args:
+                        for deep in ast.walk(arg):
+                            da = _self_attr(deep)
+                            if da is not None:
+                                info.releases.setdefault(name,
+                                                         set()).add(da)
+                            if isinstance(deep, ast.Name) and \
+                                    deep.id in local_res:
+                                local_res[deep.id]["closed"] = True
+                    continue  # os.close(v) is a release, not an escape
+                if f.attr == "join":
+                    if recv_attr is not None:
+                        info.joins.setdefault(name, set()).add(recv_attr)
+                    elif recv_name in local_threads:
+                        local_threads[recv_name]["joined"] = True
+                elif f.attr in _RELEASE_METHODS:
+                    info.release_methods.add(name)
+                    # precise: the release call's receiver names self.X
+                    for deep in ast.walk(f.value):
+                        da = _self_attr(deep)
+                        if da is not None:
+                            info.releases.setdefault(name, set()).add(da)
+                    if recv_name in local_res:
+                        local_res[recv_name]["closed"] = True
+            # a tracked local passed as an ARGUMENT may be retained by the
+            # callee — ownership is unclear, don't flag
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                for deep in ast.walk(arg):
+                    if isinstance(deep, ast.Name) and (
+                            deep.id in local_threads or deep.id in local_res):
+                        escaped.add(deep.id)
+        for expr in escape_exprs:
+            for deep in ast.walk(expr):
+                if isinstance(deep, ast.Name):
+                    escaped.add(deep.id)
+        info.method_refs[name] = refs
+
+        for var, t in local_threads.items():
+            if var in escaped or t["daemon"] or t["joined"]:
+                continue
+            self.add(Finding(
+                "thread-leak", rel, t["line"], scope,
+                f"local thread `{var}` is neither daemonized nor joined in "
+                "this function — it outlives its owner and wedges "
+                "interpreter exit",
+                f"local:{var}"))
+        for var, r in local_res.items():
+            if var in escaped or r["closed"]:
+                continue
+            self.add(Finding(
+                "resource-leak", rel, r["line"], scope,
+                f"local {r['kind']} `{var}` is never closed in this "
+                "function and does not escape — leaked on every call",
+                f"local:{r['kind']}:{var}"))
+
     # -- lock-order graph ----------------------------------------------------
+
+    def _lock_closure(self, info: _ClassInfo) -> Dict[str, Set[str]]:
+        """Interprocedural (through ``self`` calls) closure of the lock
+        tokens each method's call tree can acquire."""
+        closure: Dict[str, Set[str]] = {
+            m: set(s.acquires) for m, s in info.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, s in info.methods.items():
+                for callee in s.calls:
+                    extra = closure.get(callee, set()) - closure[m]
+                    if extra:
+                        closure[m] |= extra
+                        changed = True
+        return closure
 
     def _check_lock_order(self) -> None:
         for info in self.classes:
             edges: Dict[str, Set[str]] = {}
             edge_site: Dict[Tuple[str, str], Tuple[int, str]] = {}
             # interprocedural closure: all locks a method's call tree takes
-            closure: Dict[str, Set[str]] = {
-                m: set(s.acquires) for m, s in info.methods.items()}
-            changed = True
-            while changed:
-                changed = False
-                for m, s in info.methods.items():
-                    for callee in s.calls:
-                        extra = closure.get(callee, set()) - closure[m]
-                        if extra:
-                            closure[m] |= extra
-                            changed = True
+            closure = self._lock_closure(info)
             for m, s in info.methods.items():
                 for held, acquired, line in s.nested:
                     if held != acquired:
@@ -725,6 +1103,207 @@ class Linter:
                     f"'{method}' (via `{recv}`) does not resolve to a "
                     f"public method on {where}",
                     f"unknown:{method}"))
+
+    # -- cross-process wait cycles -------------------------------------------
+
+    def _resolve_service(self, recv: str) -> Optional[str]:
+        for pattern, candidate in _CLIENT_TABLE:
+            if pattern in recv and candidate in self.services:
+                return candidate
+        return None
+
+    def _service_rpc_closure(self, info: _ClassInfo) \
+            -> Dict[str, List[_RpcSite]]:
+        """Per-method set of RPC dispatch sites reachable through ``self``
+        calls, propagating the held-lock context: a site reached via a
+        call made under lock L inherits L when the site itself recorded
+        no held lock."""
+        closure: Dict[str, Dict[Tuple, _RpcSite]] = {
+            m: {(r.recv, r.method, r.kind, r.held): r
+                for r in s.rpc_calls}
+            for m, s in info.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, s in info.methods.items():
+                held_by_callee: Dict[str, str] = {}
+                for held, callee, _line in s.held_calls:
+                    held_by_callee.setdefault(callee, held)
+                for callee in s.calls:
+                    for site in list(closure.get(callee, {}).values()):
+                        held = site.held or held_by_callee.get(callee)
+                        key = (site.recv, site.method, site.kind, held)
+                        if key not in closure[m]:
+                            closure[m][key] = _RpcSite(
+                                site.recv, site.method, site.kind, held,
+                                site.line, f"{info.name}.{m} → {site.via}")
+                            changed = True
+        return {m: list(d.values()) for m, d in closure.items()}
+
+    def _check_rpc_cycle(self) -> None:
+        if not self.services:
+            return
+        # node = "Service.handler"; edge = blocking .call issued while
+        # serving the source handler, landing on the target handler. One
+        # representative site per (src, dst, held) — a second call site on
+        # the same edge under a DIFFERENT lock is a distinct deadlock
+        # candidate and must not be collapsed away.
+        edges: Dict[str, Set[str]] = {}
+        edge_sites: Dict[Tuple[str, str],
+                         Dict[Optional[str], Tuple[str, _RpcSite]]] = {}
+        lock_closures: Dict[str, Dict[str, Set[str]]] = {}
+        for svc, info in self.services.items():
+            lock_closures[svc] = self._lock_closure(info)
+            sites = self._service_rpc_closure(info)
+            for m in sorted(info.public_methods):
+                for site in sites.get(m, ()):
+                    if site.kind != "call":
+                        continue  # notify/call_async don't park the caller
+                    target = self._resolve_service(site.recv)
+                    if target is None:
+                        continue
+                    if site.method not in \
+                            self.services[target].public_methods:
+                        continue
+                    src, dst = f"{svc}.{m}", f"{target}.{site.method}"
+                    edges.setdefault(src, set()).add(dst)
+                    edge_sites.setdefault((src, dst), {}).setdefault(
+                        site.held, (svc, site))
+
+        in_cycle_edges: Set[Tuple[str, str]] = set()
+        for cycle in _find_cycles(edges):
+            pairs = list(zip(cycle, cycle[1:] + [cycle[0]]))
+            in_cycle_edges.update(pairs)
+            svc, site = next(iter(edge_sites[pairs[0]].values()))
+            pretty = " -> ".join(cycle + [cycle[0]])
+            self.add(Finding(
+                "rpc-cycle", self.services[svc].path, site.line, cycle[0],
+                f"cross-process RPC wait cycle: {pretty} — each handler "
+                "blocks in .call until the next replies; when the chain "
+                "lands back on the origin process both sides park forever "
+                "(make one hop a notify/call_async, or move the work off "
+                "the handler)",
+                "cycle:" + "->".join(sorted(set(cycle)))))
+        # lock-held blocking edges: flagged when the edge participates in a
+        # handler cycle, or when the remote handler chain can RPC back into
+        # a method of the CALLER's class that needs the held lock (the
+        # per-class lock graph composed with the RPC edges)
+        for (src, dst), by_held in sorted(edge_sites.items()):
+            for held, (svc, site) in sorted(
+                    by_held.items(), key=lambda kv: kv[0] or ""):
+                if held is None:
+                    continue
+                path = self.services[svc].path
+                if (src, dst) in in_cycle_edges:
+                    self.add(Finding(
+                        "rpc-cycle", path, site.line, src,
+                        f"blocking RPC to {dst} issued while holding "
+                        f"{held} participates in a handler wait cycle — "
+                        "the reply this thread is parked on can itself "
+                        f"need {held}",
+                        f"lock-held:{held}->{dst}"))
+                    continue
+                for node in self._reachable(edges, dst):
+                    tsvc, tm = node.split(".", 1)
+                    if tsvc == svc and held in \
+                            lock_closures[svc].get(tm, ()):
+                        self.add(Finding(
+                            "rpc-cycle", path, site.line, src,
+                            f"blocking RPC to {dst} issued while holding "
+                            f"{held}; the serving side can call back "
+                            f"into {node}, which acquires {held} — "
+                            "distributed deadlock when both block",
+                            f"lock-rpc:{held}:{dst}=>{node}"))
+                        break
+
+    @staticmethod
+    def _reachable(edges: Dict[str, Set[str]], start: str) -> Set[str]:
+        out, stack = {start}, [start]
+        while stack:
+            for nxt in edges.get(stack.pop(), ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
+        return out
+
+    # -- thread / resource lifecycle -----------------------------------------
+
+    def _shutdown_reachable(self, info: _ClassInfo) -> Set[str]:
+        """Methods reachable (via ``self`` calls) from a shutdown-path
+        entry point."""
+        reach = {m for m in info.methods
+                 if m in _SHUTDOWN_ENTRY_NAMES
+                 or any(k in m for k in ("shutdown", "close", "stop",
+                                         "teardown", "cleanup", "clear",
+                                         "destroy"))}
+        changed = True
+        while changed:
+            changed = False
+            for m in list(reach):
+                for callee in info.methods[m].calls:
+                    if callee in info.methods and callee not in reach:
+                        reach.add(callee)
+                        changed = True
+        return reach
+
+    def _check_thread_leaks(self) -> None:
+        for info in self.classes:
+            if not info.thread_sites:
+                continue
+            reach = self._shutdown_reachable(info)
+            joined_reachable: Set[str] = set()
+            joined_anywhere: Set[str] = set()
+            for m, attrs in info.joins.items():
+                joined_anywhere |= attrs
+                if m in reach:
+                    joined_reachable |= attrs
+            for site in info.thread_sites:
+                if site.daemon or site.attr in info.daemon_attrs:
+                    continue
+                if site.attr in joined_reachable:
+                    continue
+                if site.attr in joined_anywhere:
+                    msg = (f"non-daemon thread `self.{site.attr}` is "
+                           "joined, but not from any shutdown-path method "
+                           f"({'/'.join(sorted(reach)) or 'none found'}) — "
+                           "a shutdown that skips that path leaks it")
+                else:
+                    msg = (f"non-daemon thread `self.{site.attr}` has no "
+                           "reachable join() — pass daemon=True or join "
+                           "it from close()/shutdown()")
+                self.add(Finding("thread-leak", info.path, site.line,
+                                 site.scope, msg, f"unjoined:{site.attr}"))
+
+    def _check_resource_leaks(self) -> None:
+        for info in self.classes:
+            if not info.resource_sites or info.name == "<module>":
+                continue
+            reach = self._shutdown_reachable(info)
+            seen: Set[str] = set()
+            for site in info.resource_sites:
+                if site.attr in seen:
+                    continue  # one finding per attr, not per acquire site
+                seen.add(site.attr)
+                released = False
+                for m in reach:
+                    if site.attr in info.releases.get(m, ()):
+                        released = True  # precise: self.X.close() et al.
+                        break
+                    if m in info.release_methods and \
+                            site.attr in info.method_refs.get(m, ()):
+                        released = True  # coarse: fd-cache drain loops
+                        break
+                if released:
+                    continue
+                what = (f"{site.kind} cache `self.{site.attr}`" if
+                        site.is_dict else
+                        f"{site.kind} `self.{site.attr}`")
+                self.add(Finding(
+                    "resource-leak", info.path, site.line, site.scope,
+                    f"{what} has no release reachable from a shutdown-path "
+                    "method (close/shutdown/stop/__exit__ ...) — leaked "
+                    "on owner teardown",
+                    f"unreleased:{site.kind}:{site.attr}"))
 
     # -- config knobs --------------------------------------------------------
 
@@ -865,16 +1444,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="rewrite the baseline with current findings")
     parser.add_argument("--no-baseline", action="store_true",
                         help="report every finding; exit 1 if any")
+    parser.add_argument("--check-baseline", action="store_true",
+                        help="explicit CI mode: diff findings against the "
+                             "baseline and exit 1 on anything new (this is "
+                             "also the default behavior)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline file (default: %(default)s)")
+    parser.add_argument("--profile", action="store_true",
+                        help="print per-check wall time")
     parser.add_argument("-q", "--quiet", action="store_true")
     args = parser.parse_args(argv)
 
     roots = args.paths or [os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))]
     findings: List[Finding] = []
+    timings: Dict[str, float] = {}
     for root in roots:
-        findings.extend(Linter(root).run())
+        linter = Linter(root)
+        findings.extend(linter.run())
+        for phase, dt in linter.timings.items():
+            timings[phase] = timings.get(phase, 0.0) + dt
+
+    if args.profile:
+        for phase, dt in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {phase:<14} {dt * 1000:8.1f} ms", file=sys.stderr)
 
     if args.update_baseline:
         write_baseline(args.baseline, findings)

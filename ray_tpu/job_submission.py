@@ -41,23 +41,27 @@ class _JobSupervisor:
         self.log_path = log_path
         self.status = JobStatus.PENDING
         self.returncode: Optional[int] = None
-        self._log_file = open(log_path, "wb")
-        child_env = {**os.environ, **env, "RAY_TPU_JOB_ID": job_id}
-        self._proc = subprocess.Popen(
-            entrypoint,
-            shell=True,
-            stdout=self._log_file,
-            stderr=subprocess.STDOUT,
-            env=child_env,
-            start_new_session=True,
-        )
+        # The child dups the log fd at spawn; close the parent's copy right
+        # away instead of holding one fd per running job until exit.
+        log_file = open(log_path, "wb")
+        try:
+            child_env = {**os.environ, **env, "RAY_TPU_JOB_ID": job_id}
+            self._proc = subprocess.Popen(
+                entrypoint,
+                shell=True,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                env=child_env,
+                start_new_session=True,
+            )
+        finally:
+            log_file.close()
         self.status = JobStatus.RUNNING
         self._waiter = threading.Thread(target=self._wait, daemon=True)
         self._waiter.start()
 
     def _wait(self):
         self.returncode = self._proc.wait()
-        self._log_file.close()
         if self.status != JobStatus.STOPPED:
             self.status = (
                 JobStatus.SUCCEEDED if self.returncode == 0 else JobStatus.FAILED

@@ -112,8 +112,12 @@ class GrpcProxy:
         self._allow_pickle = allow_pickle
         self._handles: Dict[str, DeploymentHandle] = {}
         self._lock = threading.Lock()
+        # Own the handler pool: grpc's Server does not shut down a
+        # user-provided executor, so stop() must — 16 parked threads per
+        # proxy restart otherwise.
+        self._pool = futures.ThreadPoolExecutor(max_workers=16)
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=16),
+            self._pool,
             handlers=(_GenericServeHandler(self),),
         )
         self.port = self._server.add_insecure_port(f"{host}:{port}")
@@ -130,7 +134,18 @@ class GrpcProxy:
         self._server.start()
 
     def stop(self) -> None:
-        self._server.stop(grace=1.0)
+        if self._server is None:
+            return  # already stopped
+        self._server.stop(grace=1.0).wait(timeout=2.0)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        # cygrpc keeps the server's epoll/eventfd pair until the Server
+        # object is DEALLOCATED, not until stop(): drop our reference and
+        # collect so a stopped ingress releases its kernel objects now
+        # (proxies restart on every deployment update).
+        self._server = None
+        import gc
+
+        gc.collect()
 
     @property
     def num_in_flight(self) -> int:

@@ -94,7 +94,9 @@ class HttpProxy:
             loop.run_forever()
         finally:
             loop.run_until_complete(runner.cleanup())
-            loop.close()
+            from ray_tpu.utils.eventloop import drain_and_close_loop
+
+            drain_and_close_loop(loop, "serve.proxy")
 
     # -- routing -------------------------------------------------------------
     def _refresh_routes(self) -> None:

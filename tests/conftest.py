@@ -57,7 +57,51 @@ from ray_tpu.devtools import lockcheck as _lockcheck  # noqa: E402
 
 _LOCKCHECK_ON = _lockcheck.maybe_install()
 
+# Opt-in runtime leak validation (ray_tpu.devtools.leakcheck): with
+# RAY_TPU_LEAK_CHECK_ENABLED=1 threads/fds/sockets are stamped with their
+# allocation site, and the autouse fixture below snapshots live
+# threads/open fds/own shm segments per test and FAILS any test whose
+# teardown leaves new ones behind, naming each survivor.
+from ray_tpu.devtools import leakcheck as _leakcheck  # noqa: E402
+
+_LEAKCHECK_ON = _leakcheck.maybe_install()
+
 TEST_TIMEOUT_S = 180  # matches the reference's pytest.ini per-test timeout
+
+
+def pytest_sessionstart(session):
+    """With RAY_TPU_LINT_IN_CI=1, run raylint against its baseline before
+    the suite: tier-1 fails on NEW static findings without a separate CI
+    job (`python -m ray_tpu.devtools.lint --check-baseline`)."""
+    if os.environ.get("RAY_TPU_LINT_IN_CI", "").lower() not in (
+            "1", "true", "yes", "on"):
+        return
+    from ray_tpu.devtools import lint
+
+    if lint.main(["--check-baseline"]) != 0:
+        raise pytest.UsageError(
+            "raylint found NEW findings (RAY_TPU_LINT_IN_CI=1) — fix them "
+            "or accept deliberately with "
+            "`python -m ray_tpu.devtools.lint --update-baseline`")
+
+
+@pytest.fixture(autouse=True)
+def _leak_guard(request):
+    """With leakcheck installed, fail any test that leaks a thread, fd, or
+    shm segment past teardown. Defined FIRST among the autouse fixtures so
+    it wraps them all: the snapshot runs before ray_start_* setup and the
+    diff after their teardown. `@pytest.mark.leaks("reason")` opts a test
+    out (e.g. intentional-crash tests that orphan resources by design)."""
+    if not _LEAKCHECK_ON:
+        yield
+        return
+    before = _leakcheck.snapshot()
+    yield
+    if request.node.get_closest_marker("leaks") is not None:
+        return
+    leaked = _leakcheck.check(before)
+    assert not leaked, (
+        "resources leaked past test teardown:\n  " + "\n  ".join(leaked))
 
 
 @pytest.fixture(autouse=True)

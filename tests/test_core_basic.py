@@ -131,6 +131,7 @@ def test_nested_tasks_no_deadlock(ray_start_regular):
     assert results == [i * 2 + 1 for i in range(10)]
 
 
+@pytest.mark.leaks("abandons an in-flight sleeping task: the in-process runtime cannot interrupt user code mid-sleep")
 def test_wait(ray_start_regular):
     rt = ray_start_regular
 
@@ -149,6 +150,7 @@ def test_wait(ray_start_regular):
     assert not_ready == [s]
 
 
+@pytest.mark.leaks("abandons an in-flight sleeping task: the in-process runtime cannot interrupt user code mid-sleep")
 def test_wait_timeout_empty(ray_start_regular):
     rt = ray_start_regular
 
@@ -161,6 +163,7 @@ def test_wait_timeout_empty(ray_start_regular):
     assert len(not_ready) == 1
 
 
+@pytest.mark.leaks("abandons an in-flight sleeping task: the in-process runtime cannot interrupt user code mid-sleep")
 def test_get_timeout(ray_start_regular):
     rt = ray_start_regular
 
@@ -184,6 +187,7 @@ def test_generator_streaming(ray_start_regular):
     assert items == [0, 1, 4, 9, 16]
 
 
+@pytest.mark.leaks("abandons an in-flight sleeping task: the in-process runtime cannot interrupt user code mid-sleep")
 def test_cancel_pending(ray_start_regular):
     rt = ray_start_regular
 

@@ -1,0 +1,464 @@
+"""raylint v2: cross-process RPC wait-cycle analysis, thread/resource
+lifecycle checks, and the runtime leak validator.
+
+Seeded fixtures trip each new check; the real tree must stay clean against
+the checked-in baseline (the PR 4 gate already enforces that — these tests
+add coverage guards proving the NEW passes actually see the hot modules);
+leakcheck units prove the dynamic half names leaked threads/fds with their
+allocation sites.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.devtools import leakcheck, lint
+
+
+def _write(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# rpc-cycle: cross-process wait cycles
+# ---------------------------------------------------------------------------
+
+_CYCLE_SRC = """
+    import threading
+
+    class GcsService:
+        def __init__(self):
+            self._daemons = Pool()
+            self._server = RpcServer(self)
+
+        def kill_node(self, addr):
+            # handler blocks on an RPC whose handler can call back here
+            return self._daemons.get(addr).call("drain")
+
+    class NodeDaemon:
+        def __init__(self):
+            self._gcs = Client()
+            self._lock = threading.Lock()
+            self._server = RpcServer(self)
+
+        def drain(self):
+            return self._helper()
+
+        def _helper(self):
+            with self._lock:
+                return self._gcs.call("kill_node", "self")
+    """
+
+
+def test_rpc_cycle_detected(tmp_path):
+    _write(tmp_path, "svc.py", _CYCLE_SRC)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-cycle"]
+    cycles = [f for f in findings if f.detail.startswith("cycle:")]
+    assert cycles, findings
+    assert "GcsService.kill_node" in cycles[0].message
+    assert "NodeDaemon.drain" in cycles[0].message
+    # the interprocedural hop (drain -> _helper -> .call) was followed, and
+    # the lock held across the in-cycle RPC edge is flagged too
+    held = [f for f in findings if f.detail.startswith("lock-held:")]
+    assert held and "NodeDaemon._lock" in held[0].message, findings
+
+
+def test_rpc_cycle_notify_edge_is_not_a_wait_edge(tmp_path):
+    _write(tmp_path, "svc.py", _CYCLE_SRC.replace(
+        '.call("drain")', '.notify("drain")'))
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-cycle"]
+    # one hop became fire-and-forget: nobody parks, no cycle
+    assert not [f for f in findings if f.detail.startswith("cycle:")], \
+        findings
+
+
+def test_rpc_lock_composition_without_handler_cycle(tmp_path):
+    _write(tmp_path, "svc.py", """
+        import threading
+
+        class GcsService:
+            def __init__(self):
+                self._daemons = Pool()
+                self._lock = threading.Lock()
+                self._server = RpcServer(self)
+
+            def update(self):
+                with self._lock:
+                    return self._daemons.get("x").call("apply")
+
+            def read_state(self):
+                with self._lock:
+                    return 1
+
+        class NodeDaemon:
+            def __init__(self):
+                self._gcs = Client()
+                self._server = RpcServer(self)
+
+            def apply(self):
+                return self._gcs.call("read_state")
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-cycle"]
+    # no handler->handler cycle (read_state has no outgoing edge) ...
+    assert not [f for f in findings if f.detail.startswith("cycle:")]
+    # ... but update blocks on apply while holding _lock, and apply calls
+    # back into read_state, which NEEDS _lock: composed deadlock
+    lock_rpc = [f for f in findings if f.detail.startswith("lock-rpc:")]
+    assert lock_rpc, findings
+    assert "GcsService._lock" in lock_rpc[0].message
+    assert "GcsService.read_state" in lock_rpc[0].message
+
+
+def test_rpc_cycle_lock_held_site_not_shadowed_by_unlocked_site(tmp_path):
+    # the SAME edge dispatched twice — once lock-free, once under a lock:
+    # collapsing to the first site must not hide the lock-held finding
+    _write(tmp_path, "svc.py", """
+        import threading
+
+        class GcsService:
+            def __init__(self):
+                self._daemons = Pool()
+                self._lock = threading.Lock()
+                self._server = RpcServer(self)
+
+            def kill_node(self, addr):
+                self._daemons.get(addr).call("drain")   # lock-free first
+                with self._lock:
+                    return self._daemons.get(addr).call("drain")
+
+        class NodeDaemon:
+            def __init__(self):
+                self._gcs = Client()
+                self._server = RpcServer(self)
+
+            def drain(self):
+                return self._gcs.call("kill_node", "self")
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-cycle"]
+    held = [f for f in findings if f.detail.startswith("lock-held:")]
+    assert held and "GcsService._lock" in held[0].message, findings
+
+
+def test_rpc_cycle_pragma_suppression(tmp_path):
+    _write(tmp_path, "svc.py", _CYCLE_SRC.replace(
+        'return self._daemons.get(addr).call("drain")',
+        '# raylint: ignore[rpc-cycle] — reviewed: daemon never calls back\n'
+        '            return self._daemons.get(addr).call("drain")'))
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "rpc-cycle" and f.detail.startswith("cycle:")]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
+# thread-leak
+# ---------------------------------------------------------------------------
+
+
+def test_unjoined_nondaemon_attr_thread_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Leaky:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "thread-leak"]
+    assert len(findings) == 1 and findings[0].detail == "unjoined:_t", \
+        findings
+
+
+def test_annotated_assign_thread_site_is_seen(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Typed:
+            def __init__(self):
+                self._t: threading.Thread = threading.Thread(target=print)
+                self._t.start()
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "thread-leak"]
+    assert len(findings) == 1 and findings[0].detail == "unjoined:_t", \
+        findings
+
+
+def test_joined_daemonized_and_local_threads(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Fine:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+                self._d = threading.Thread(target=self._run, daemon=True)
+                self._late = threading.Thread(target=self._run)
+                self._late.daemon = True
+
+            def _run(self):
+                pass
+
+            def shutdown(self):
+                self._stop()
+
+            def _stop(self):
+                self._t.join(timeout=2.0)   # reachable via shutdown()
+
+        def local_joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+
+        def local_leaky():
+            t = threading.Thread(target=print)
+            t.start()
+
+        def anonymous_leaky():
+            threading.Thread(target=print).start()
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "thread-leak"]
+    details = {f.detail for f in findings}
+    assert details == {"local:t", "anonymous-thread"}, findings
+    assert {f.scope for f in findings} == {"local_leaky",
+                                           "anonymous_leaky"}, findings
+
+
+# ---------------------------------------------------------------------------
+# resource-leak
+# ---------------------------------------------------------------------------
+
+
+def test_shm_acquire_without_release_detected(tmp_path):
+    _write(tmp_path, "mod.py", """
+        from multiprocessing.shared_memory import SharedMemory
+
+        class Seg:
+            def __init__(self):
+                self._seg = SharedMemory(name="x", create=True, size=64)
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "resource-leak"]
+    assert len(findings) == 1
+    assert findings[0].detail == "unreleased:shm:_seg", findings
+
+
+def test_released_resources_and_fd_cache_are_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import os
+        import socket
+        from multiprocessing.shared_memory import SharedMemory
+
+        class Fine:
+            def __init__(self):
+                self._seg = SharedMemory(name="x", create=True, size=64)
+                self._sock = socket.socket()
+                self._fds = {}
+                self._fds["k"] = os.open("/tmp/x", os.O_RDONLY)
+
+            def _open_more(self, key):
+                fd = os.open(key, os.O_RDONLY)
+                self._fds[key] = fd
+
+            def close(self):
+                self._seg.close()
+                self._seg.unlink()
+                self._sock.close()
+                for fd in self._fds.values():
+                    os.close(fd)
+                self._fds.clear()
+
+        def local_closed():
+            s = socket.socket()
+            s.close()
+
+        def local_escapes():
+            s = socket.socket()
+            return s
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "resource-leak"]
+    assert not findings, findings
+
+
+def test_local_socket_leak_detected_and_pragma(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import socket
+
+        def leaky():
+            s = socket.socket()
+            s.connect(("127.0.0.1", 1))
+
+        def reviewed():
+            # raylint: ignore[resource-leak] — reviewed: process-lifetime
+            s = socket.socket()
+            s.connect(("127.0.0.1", 1))
+        """)
+    findings = [f for f in lint.lint_tree(str(tmp_path))
+                if f.check == "resource-leak"]
+    assert len(findings) == 1 and findings[0].scope == "leaky", findings
+    assert findings[0].detail == "local:socket:s"
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip with the new checks
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip_new_checks(tmp_path):
+    _write(tmp_path, "svc.py", _CYCLE_SRC)
+    baseline = tmp_path / "baseline.txt"
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline), "-q"])
+    assert rc == 1  # dirty vs empty baseline
+    assert lint.main([str(tmp_path), "--baseline", str(baseline),
+                      "--update-baseline"]) == 0
+    assert lint.main([str(tmp_path), "--baseline", str(baseline),
+                      "--check-baseline", "-q"]) == 0  # accepted
+    _write(tmp_path, "mod2.py", """
+        import threading
+
+        class Leaky2:
+            def __init__(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+        """)
+    rc = lint.main([str(tmp_path), "--baseline", str(baseline), "-q"])
+    assert rc == 1  # the NEW thread-leak fails; accepted cycle stays quiet
+
+
+# ---------------------------------------------------------------------------
+# report runtime: shared AST cache + --profile timings
+# ---------------------------------------------------------------------------
+
+
+def test_ast_cache_and_profile_timings(tmp_path):
+    p = _write(tmp_path, "mod.py", "x = 1\n")
+    t1, _ = lint._parse_cached(str(p))
+    t2, _ = lint._parse_cached(str(p))
+    assert t1 is t2  # cached: same tree object, no re-parse
+    p.write_text("x = 2\n")
+    t3, _ = lint._parse_cached(str(p))
+    assert t3 is not t1  # edit invalidates
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    linter = lint.Linter(root)
+    linter.run()
+    assert {"parse", "scan", "lock-order", "rpc-cycle", "thread-leak",
+            "resource-leak", "total"} <= set(linter.timings)
+    # full-tree lint stays fast enough to run inside tier-1
+    assert linter.timings["total"] < 15.0, linter.timings
+
+
+# ---------------------------------------------------------------------------
+# coverage guard: the new passes actually see the hot modules
+# ---------------------------------------------------------------------------
+
+
+def test_new_checks_cover_hot_modules():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    linter = lint.Linter(root)
+    findings = linter.run()
+
+    by_name = {}
+    for c in linter.classes:
+        by_name.setdefault(c.name, c)
+
+    # resource scan saw the daemon's spill-chunk fd cache — and the
+    # shutdown path releases it (the leak this PR fixed stays fixed)
+    nd = by_name["NodeDaemon"]
+    assert any(s.attr == "_spill_fds" and s.is_dict and s.kind == "fd"
+               for s in nd.resource_sites)
+    assert not any(f.check == "resource-leak" and "_spill_fds" in f.detail
+                   for f in findings)
+
+    # thread scan saw the metrics exporter thread
+    exp = by_name["MetricsExporter"]
+    assert any(s.attr == "_thread" for s in exp.thread_sites)
+
+    # the inter-process graph has real blocking edges between the services
+    edges = set()
+    for svc, info in linter.services.items():
+        for m, sites in linter._service_rpc_closure(info).items():
+            if m not in info.public_methods:
+                continue
+            for site in sites:
+                tgt = linter._resolve_service(site.recv)
+                if tgt and site.kind == "call" and \
+                        site.method in linter.services[tgt].public_methods:
+                    edges.add((f"{svc}.{m}", f"{tgt}.{site.method}"))
+    assert ("NodeDaemon.execute_task", "WorkerService.run_task") in edges
+    assert any(src.startswith("GcsService.") for src, _ in edges)
+
+    # and the whole tree is currently wait-cycle free
+    assert not [f for f in findings if f.check == "rpc-cycle"], \
+        [f.render() for f in findings if f.check == "rpc-cycle"]
+
+
+# ---------------------------------------------------------------------------
+# leakcheck: the runtime half
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def leak_installed():
+    was = leakcheck.installed()
+    leakcheck.install()
+    yield leakcheck
+    if not was:
+        leakcheck.uninstall()
+
+
+def test_leakcheck_names_thread_leak_with_site(leak_installed):
+    before = leakcheck.snapshot()
+    ev = threading.Event()
+    t = threading.Thread(target=ev.wait, daemon=True, name="leaky-thread")
+    t.start()
+    try:
+        leaks = leakcheck.check(before, settle_s=0.1)
+        assert any("leaky-thread" in l for l in leaks), leaks
+        # allocation site points at THIS file
+        assert any("test_devtools_lint2.py" in l for l in leaks), leaks
+    finally:
+        ev.set()
+        t.join()
+    assert leakcheck.check(before, settle_s=2.0) == []
+
+
+def test_leakcheck_names_fd_leak_with_site(leak_installed):
+    before = leakcheck.snapshot()
+    fd = os.open("/tmp", os.O_RDONLY)
+    try:
+        leaks = leakcheck.check(before, settle_s=0.05)
+        assert any(f"fd {fd}" in l for l in leaks), leaks
+        assert any("os.open" in l and "test_devtools_lint2.py" in l
+                   for l in leaks), leaks
+    finally:
+        os.close(fd)
+    assert leakcheck.check(before, settle_s=0.5) == []
+
+
+def test_leakcheck_clean_teardown_is_clean(leak_installed):
+    before = leakcheck.snapshot()
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    fd = os.open("/tmp", os.O_RDONLY)
+    os.close(fd)
+    import socket as socket_mod
+
+    s = socket_mod.socket()
+    s.close()
+    assert leakcheck.check(before, settle_s=1.0) == []
