@@ -31,14 +31,15 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._method_name, args, kwargs, {})
 
-    def bind(self, upstream):
+    def bind(self, *args):
         """Author a compiled-DAG stage (reference: ``dag_node.py`` bind API;
-        compile with ``.experimental_compile()``)."""
-        from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode
+        compile with ``.experimental_compile()``). Each arg is an upstream
+        DAG node (fan-in: one channel-fed value per tick) or a constant
+        baked into every call; at least one must be a DAG node."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
 
-        if not isinstance(upstream, DAGNode):
-            raise TypeError("bind() takes an InputNode or another DAG node")
-        return ClassMethodNode(self._handle, self._method_name, upstream)
+        # ClassMethodNode validates that at least one arg is a DAG node.
+        return ClassMethodNode(self._handle, self._method_name, *args)
 
     def options(self, **overrides):
         handle, name = self._handle, self._method_name

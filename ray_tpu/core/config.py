@@ -193,6 +193,31 @@ class Config:
     # tests lower this to fail fast on a lost rank.
     collective_timeout_s = _Flag(120.0)
 
+    # -- compiled DAGs ---------------------------------------------------------
+    # Ring depth of a compiled-DAG shm channel: how many ticks can be in
+    # flight on one edge before the writer blocks on the reader's ack.
+    # 1 restores the capacity-1 seqlock channel (strict lock-step hand-off);
+    # deeper rings let burst submission pipeline through the stages.
+    dag_channel_slots = _Flag(8)
+    # Busy-spin iterations before a blocked channel endpoint falls back to
+    # sleep-polling. 0 measured best on core-constrained hosts: spinning
+    # starves the peer process of the CPU it needs to make progress.
+    dag_channel_tight_spins = _Flag(0)
+    # Sleep-poll granularity (microseconds) for a blocked channel endpoint;
+    # backs off exponentially to 40x this while idle. Lower = lower hand-off
+    # latency on idle cores, higher = less wasted wakeup churn.
+    dag_channel_spin_us = _Flag(50.0)
+    # Credit window of a cross-host SocketChannel edge: frames the writer
+    # may send ahead of the reader's acks. 1 restores per-frame lock-step
+    # (every write stalls on an ack round-trip); wider windows let burst
+    # submission pipeline over the network like the shm ring does on-host.
+    dag_socket_window = _Flag(8)
+    # Bound on CompiledDAG.teardown's drain: how long to wait for the stage
+    # loops to observe the close pill and detach their channel endpoints
+    # before the driver unlinks the shm files (a stage mid-read must not
+    # see its backing file vanish).
+    dag_teardown_timeout_s = _Flag(10.0)
+
     # -- metrics / observability ----------------------------------------------
     # Cluster-wide metrics pipeline: every process (gcs_server, node_daemon,
     # worker, driver) runs an exporter thread that snapshots its

@@ -99,6 +99,13 @@ def serve_request_hist() -> um.Histogram:
         tag_keys=("deployment",))
 
 
+def dag_tick_hist() -> um.Histogram:
+    return _metric(
+        um.Histogram, "ray_tpu_dag_tick_s",
+        "Compiled-DAG tick latency (execute write to result fetch)",
+        boundaries=_LATENCY_BOUNDS)
+
+
 def serve_batch_hist() -> um.Histogram:
     return _metric(um.Histogram, "ray_tpu_serve_batch_size",
                    "Serve @batch flush sizes", boundaries=_BATCH_BOUNDS)

@@ -1,23 +1,29 @@
 """ray_tpu.dag — compiled static actor DAGs (aDAG analog).
 
-Public surface mirrors ``python/ray/dag``: ``InputNode``, ``.bind()`` on
-actor methods, ``experimental_compile()`` → resident actor loops over
-mutable shm channels (same-host scope in v1; the reference's cross-node
-channel transport is a later extension).
+Public surface mirrors ``python/ray/dag``: ``InputNode``, multi-arg
+``.bind()`` on actor methods, ``MultiOutputNode`` for gathered leaves,
+``experimental_compile()`` → resident actor loops over mutable multi-slot
+shm ring channels (same host), credit-windowed socket channels (cross
+host), or device channels (``jax.Array`` payloads with ring-buffered host
+DMA).
 """
 
-from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
+from ray_tpu.dag.channel import (Channel, ChannelClosed, ChannelTimeout,
+                                 SocketChannel)
 from ray_tpu.dag.compiled_dag import CompiledDAG, DAGRef
-from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
+                                  MultiOutputNode)
 from ray_tpu.dag.device_channel import DeviceChannel
 
 __all__ = [
     "InputNode",
     "DAGNode",
     "ClassMethodNode",
+    "MultiOutputNode",
     "CompiledDAG",
     "DAGRef",
     "Channel",
+    "SocketChannel",
     "ChannelClosed",
     "ChannelTimeout",
     "DeviceChannel",

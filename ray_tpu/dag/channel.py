@@ -7,15 +7,24 @@ objects): a fixed shm region written in place per DAG step instead of a
 fresh sealed object per call. That removes the per-call allocate/seal/
 locate/fetch round trips that dominate fine-grained pipelined execution.
 
+The channel is an N-slot ring (``dag_channel_slots`` knob). Each slot is an
+independent seqlock cell; writer and reader walk the ring with private
+cursors, so up to N values can be in flight on one edge before the writer
+blocks on the reader's ack — burst submission pipelines through a compiled
+DAG's stages instead of serializing on per-value hand-offs. ``slots=1``
+restores the strict capacity-1 lock-step of the original design.
+
 Layout (one mmap'd file under /dev/shm, works in- and cross-process)::
 
-    [0:8)   write_seq  — odd while a write is in progress (seqlock)
-    [8:16)  ack_seq    — last write_seq the (single) reader consumed
-    [16:24) payload_len
-    [24:..) payload
+    [0:8)    nslots (stamped by the creator; attach verifies)
+    per slot, at 8 + i * stride (stride = 64-byte-aligned header+capacity):
+      [0:8)   write_seq  — odd while a write is in progress (seqlock)
+      [8:16)  ack_seq    — last write_seq the (single) reader consumed
+      [16:24) payload_len
+      [24:..) payload
 
-Writer blocks until the previous value is acked (capacity-1 backpressure,
-matching the reference); reader blocks until a new even write_seq appears.
+Writer blocks when the ring is full (its next slot's previous value is not
+yet acked); reader blocks until a new even write_seq appears in its slot.
 """
 
 from __future__ import annotations
@@ -29,15 +38,33 @@ from typing import Any, Optional, Tuple
 
 from ray_tpu.core import serialization
 
-_HEADER = struct.Struct("<QQQ")
-HEADER_SIZE = _HEADER.size
-_SPIN_S = 50e-6
-# Busy-spin iterations before falling back to sleep-polling. 0: measured on
-# core-constrained hosts, spinning starves the peer process of the CPU it
-# needs to make progress (1540µs round trip at 2000 spins vs 190µs at 0);
-# sleep granularity bounds added latency at ~2×_SPIN_S on idle cores.
-_TIGHT_SPINS = 0
-_SPIN_MAX_S = 2e-3  # idle-poll ceiling (backoff)
+_FILE_HEADER = struct.Struct("<Q")  # nslots
+_SLOT_HEADER = struct.Struct("<QQQ")  # write_seq, ack_seq, payload_len
+FILE_HEADER_SIZE = _FILE_HEADER.size
+SLOT_HEADER_SIZE = _SLOT_HEADER.size
+# Kept for DeviceChannel-era imports; the per-slot payload offset.
+HEADER_SIZE = SLOT_HEADER_SIZE
+
+
+def _spin_params() -> Tuple[int, float, float]:
+    """(tight_spins, spin_s, spin_max_s) from the config knobs.
+
+    Resolved per channel instance (not per wait iteration): the knobs are
+    process-lifetime settings, and config() is a lock + dict hit.
+    """
+    from ray_tpu.core.config import config
+
+    cfg = config()
+    spin_s = max(1e-6, float(cfg.dag_channel_spin_us) * 1e-6)
+    # Idle-poll ceiling: exponential backoff stops at 40x the granularity
+    # (2ms at the 50us default) so parked DAG loops stop burning wakeups.
+    return int(cfg.dag_channel_tight_spins), spin_s, spin_s * 40.0
+
+
+def _default_slots() -> int:
+    from ray_tpu.core.config import config
+
+    return max(1, int(config().dag_channel_slots))
 
 
 class ChannelTimeout(TimeoutError):
@@ -52,65 +79,113 @@ _CLOSE = b"\x00__ray_tpu_channel_closed__"
 
 
 class Channel:
-    """Single-writer single-reader mutable channel over shm."""
+    """Single-writer single-reader mutable ring channel over shm."""
 
     def __init__(self, name: Optional[str] = None,
-                 capacity: int = 4 * 1024 * 1024, create: bool = True):
+                 capacity: int = 4 * 1024 * 1024, create: bool = True,
+                 slots: Optional[int] = None):
         self.name = name or f"rtpu-chan-{uuid.uuid4().hex[:12]}"
         self.capacity = capacity
+        self.slots = max(1, int(slots)) if slots else _default_slots()
+        # 64-byte-align each slot so seqlock headers sit on their own cache
+        # lines (writer and reader hammer adjacent slots concurrently).
+        self._stride = -(-(SLOT_HEADER_SIZE + capacity) // 64) * 64
         path = f"/dev/shm/{self.name}"
-        size = HEADER_SIZE + capacity
+        size = FILE_HEADER_SIZE + self.slots * self._stride
+        created = False
         if create and not os.path.exists(path):
             with open(path, "wb") as f:
                 f.truncate(size)
+            created = True
         self._f = open(path, "r+b")
         self._mm = mmap.mmap(self._f.fileno(), size)
-        self._read_seq = 0  # last seq this reader consumed
+        if created:
+            _FILE_HEADER.pack_into(self._mm, 0, self.slots)
+        else:
+            stamped = _FILE_HEADER.unpack_from(self._mm, 0)[0]
+            if stamped and stamped != self.slots:
+                self._mm.close()
+                self._f.close()
+                raise ValueError(
+                    f"channel {self.name} has {stamped} slots; attach "
+                    f"requested {self.slots}")
+        self._tight_spins, self._spin_s, self._spin_max_s = _spin_params()
+        # Reattached (unpickled) endpoints detach themselves at DAG-loop
+        # exit; the creating endpoint's lifecycle belongs to the driver.
+        self._attached_endpoint = not create
+        # Private cursors: count of completed writes / reads. Slot index is
+        # cursor % slots; both endpoints start at 0 (fresh or attach-by-name
+        # before first use, the same contract the capacity-1 channel had).
+        self._wcursor = 0
+        self._rcursor = 0
+        # Last write_seq consumed per slot (reader-private).
+        self._read_seq = [0] * self.slots
 
     # -- header accessors -----------------------------------------------------
 
-    def _load(self) -> Tuple[int, int, int]:
-        return _HEADER.unpack_from(self._mm, 0)
+    def _slot_off(self, i: int) -> int:
+        return FILE_HEADER_SIZE + i * self._stride
 
-    def _store_write_seq(self, v: int) -> None:
-        struct.pack_into("<Q", self._mm, 0, v)
+    def _load(self, i: int) -> Tuple[int, int, int]:
+        return _SLOT_HEADER.unpack_from(self._mm, self._slot_off(i))
 
-    def _store_ack(self, v: int) -> None:
-        struct.pack_into("<Q", self._mm, 8, v)
+    def _store_write_seq(self, i: int, v: int) -> None:
+        struct.pack_into("<Q", self._mm, self._slot_off(i), v)
 
-    # -- API ------------------------------------------------------------------
+    def _store_ack(self, i: int, v: int) -> None:
+        struct.pack_into("<Q", self._mm, self._slot_off(i) + 8, v)
+
+    def _sleep_poll(self, spins: int) -> None:
+        # Exponential backoff to the ceiling: hot hand-offs stay at
+        # ~spin_s latency, parked DAG loops stop burning ~20k wakeups/s
+        # per stage while idle.
+        time.sleep(min(self._spin_s * (1 << min(spins // 64, 6)),
+                       self._spin_max_s))
+
+    # -- write half -----------------------------------------------------------
 
     def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
         # ALWAYS serialize — read() always deserializes; a raw-bytes fast
         # path would misparse user bytes payloads (the close pill goes
-        # through _write_raw instead).
+        # through _force_publish framing instead).
         self._write_payload(serialization.dumps(value), timeout)
 
     def _wait_writable(self, timeout: Optional[float]) -> None:
-        """Block until the previous value is acked, then mark a write in
-        progress (odd seq). Split out so callers (DeviceChannel) can land
-        payload bytes DIRECTLY in the shm region between this and
-        ``_publish`` — no intermediate buffer."""
-        deadline = None if timeout is None else time.time() + timeout
+        """Block until this writer's next ring slot is free (its previous
+        value acked), then mark a write in progress (odd seq). Split out so
+        callers (DeviceChannel) can land payload bytes DIRECTLY in the shm
+        region — ``self._wpayload_off`` — between this and ``_publish``,
+        no intermediate buffer."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        slot = self._wcursor % self.slots
         spins = 0
         while True:
-            write_seq, ack_seq, _ = self._load()
+            write_seq, ack_seq, _ = self._load(slot)
             if write_seq % 2 == 0 and ack_seq == write_seq:
-                break  # previous value consumed (or channel fresh)
-            if deadline is not None and time.time() > deadline:
-                raise ChannelTimeout(f"writer blocked on unread value in {self.name}")
+                break  # slot's previous value consumed (or slot fresh)
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout(
+                    f"writer blocked on full ring in {self.name} "
+                    f"(slot {slot}/{self.slots})")
             spins += 1
-            if spins > _TIGHT_SPINS:
-                # Exponential backoff to _SPIN_MAX_S: hot hand-offs stay at
-                # ~_SPIN_S latency, parked DAG loops stop burning ~20k
-                # wakeups/s per stage while idle.
-                time.sleep(min(_SPIN_S * (1 << min(spins // 64, 6)), _SPIN_MAX_S))
-        self._store_write_seq(write_seq + 1)          # mark in-progress (odd)
+            if spins > self._tight_spins:
+                self._sleep_poll(spins)
+        self._store_write_seq(slot, write_seq + 1)  # mark in-progress (odd)
         self._pending_write_seq = write_seq
+        self._wslot = slot
+        self._wpayload_off = self._slot_off(slot) + SLOT_HEADER_SIZE
 
     def _publish(self, length: int) -> None:
-        struct.pack_into("<Q", self._mm, 16, length)
-        self._store_write_seq(self._pending_write_seq + 2)  # publish (even)
+        struct.pack_into("<Q", self._mm, self._slot_off(self._wslot) + 16,
+                         length)
+        self._store_write_seq(self._wslot, self._pending_write_seq + 2)
+        self._wcursor += 1
+
+    def _abort_write(self) -> None:
+        """Roll a begun (odd) write back to even without advancing the
+        cursor — a failed slot fill must not wedge the seqlock."""
+        self._store_write_seq(self._wslot, self._pending_write_seq)
 
     def _write_payload(self, payload: bytes, timeout: Optional[float]) -> None:
         if len(payload) > self.capacity:
@@ -118,31 +193,56 @@ class Channel:
                 f"payload of {len(payload)} bytes exceeds channel capacity "
                 f"{self.capacity}")
         self._wait_writable(timeout)
-        self._mm[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
+        off = self._wpayload_off
+        self._mm[off:off + len(payload)] = payload
         self._publish(len(payload))
+
+    # -- read half ------------------------------------------------------------
 
     def _read_view(self, timeout: Optional[float]):
         """Block for the next value; return ``(view, length)`` WITHOUT
-        acking — the bytes stay stable (the writer can't start a new write
-        before our ack) until the caller's ``_ack_current``. The zero-copy
-        read half of the DeviceChannel protocol."""
-        deadline = None if timeout is None else time.time() + timeout
+        acking or advancing — the bytes stay stable (the writer can't reuse
+        the slot before our ack) until the caller's ``_ack_current``. The
+        zero-copy read half of the DeviceChannel protocol. Idempotent until
+        acked, which is what lets ``read()`` retry a torn copy."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        slot = self._rcursor % self.slots
         spins = 0
         while True:
-            write_seq, _ack, length = self._load()
-            if write_seq % 2 == 0 and write_seq > self._read_seq:
+            write_seq, _ack, length = self._load(slot)
+            if write_seq % 2 == 0 and write_seq > self._read_seq[slot]:
                 self._pending_read_seq = write_seq
-                return memoryview(self._mm)[
-                    HEADER_SIZE:HEADER_SIZE + length], length
-            if deadline is not None and time.time() > deadline:
+                self._rslot = slot
+                off = self._slot_off(slot) + SLOT_HEADER_SIZE
+                return memoryview(self._mm)[off:off + length], length
+            if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeout(f"no value arrived in {self.name}")
             spins += 1
-            if spins > _TIGHT_SPINS:
-                time.sleep(min(_SPIN_S * (1 << min(spins // 64, 6)), _SPIN_MAX_S))
+            if spins > self._tight_spins:
+                self._sleep_poll(spins)
 
     def _ack_current(self) -> None:
-        self._read_seq = self._pending_read_seq
-        self._store_ack(self._pending_read_seq)
+        self._ack(self._rslot, self._pending_read_seq)
+        self._rcursor += 1
+
+    def _ack(self, slot: int, seq: int) -> None:
+        """Release one slot back to the writer (deferred-ack primitive:
+        DeviceChannel acks slot k only once k's host->device DMA landed,
+        possibly after reading slot k+1)."""
+        self._read_seq[slot] = seq
+        self._store_ack(slot, seq)
+
+    def _consume_view(self, timeout: Optional[float]):
+        """Advancing read for pipelined consumers: returns ``(view, length,
+        slot, seq)`` and moves the read cursor on, WITHOUT acking — the
+        caller owns the eventual ``_ack(slot, seq)``. Unlike ``_read_view``
+        a subsequent call proceeds to the next ring slot immediately."""
+        view, length = self._read_view(timeout)
+        slot, seq = self._rslot, self._pending_read_seq
+        self._read_seq[slot] = seq  # consumed (ack still pending)
+        self._rcursor += 1
+        return view, length, slot, seq
 
     def read(self, timeout: Optional[float] = 30.0) -> Any:
         """Block until a value newer than the last read appears; ack it."""
@@ -153,79 +253,102 @@ class Channel:
             # payload mid-copy (the one writer path that skips the ack
             # handshake); a changed seq means the copy is torn — retry and
             # pick up the pill.
-            if self._load()[0] == self._pending_read_seq:
+            if self._load(self._rslot)[0] == self._pending_read_seq:
                 break
         self._ack_current()
         if payload == _CLOSE:
             raise ChannelClosed(self.name)
         return serialization.loads(payload)
 
+    # -- lifecycle ------------------------------------------------------------
+
     def _force_publish(self, payload: bytes) -> None:
-        """Teardown-only: publish ``payload`` WITHOUT waiting for the
-        reader's ack (used when the reader never drained the last value).
-        Readers detect the overwrite via the stability recheck."""
-        write_seq, _, _ = self._load()
+        """Teardown-only: publish ``payload`` into the writer's CURRENT
+        ring slot WITHOUT waiting for the reader's ack (used when the ring
+        is full because the reader never drained). The pill overwrites one
+        undelivered value — readers detect a torn copy via the stability
+        recheck, and the bumped seq satisfies their wait when the cursor
+        reaches this slot."""
+        slot = self._wcursor % self.slots
+        write_seq, _, _ = self._load(slot)
         base = write_seq if write_seq % 2 == 0 else write_seq + 1
-        self._store_write_seq(base + 1)
-        self._mm[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
-        struct.pack_into("<Q", self._mm, 16, len(payload))
-        self._store_write_seq(base + 2)
+        self._store_write_seq(slot, base + 1)
+        off = self._slot_off(slot) + SLOT_HEADER_SIZE
+        self._mm[off:off + len(payload)] = payload
+        struct.pack_into("<Q", self._mm, self._slot_off(slot) + 16,
+                         len(payload))
+        self._store_write_seq(slot, base + 2)
 
     def close(self) -> None:
         """Wake the reader with a poison pill (teardown path)."""
         try:
             self._write_payload(_CLOSE, timeout=0.5)
         except (ChannelTimeout, ValueError):
-            # Reader never drained the last value; force-publish the pill.
+            # Ring full (reader never drained); force-publish the pill.
             self._force_publish(_CLOSE)
 
-    def destroy(self) -> None:
+    def detach(self) -> None:
+        """Close THIS endpoint's mmap/fd without unlinking the backing
+        file — the worker-side half of teardown (the driver, which created
+        the channel, unlinks in ``destroy``). Idempotent."""
         try:
             self._mm.close()
         except (OSError, BufferError):
             # BufferError: a zero-copy view handed out by _read_view is
             # still referenced (e.g. a device array's source buffer whose
             # consumer hasn't been collected yet) — the mmap closes when
-            # the last view dies; unlink the backing file regardless.
+            # the last view dies.
             pass
         try:
             self._f.close()  # its own try: the fd must not leak when
         except OSError:      # mm.close() raised above
             pass
+
+    def destroy(self) -> None:
+        self.detach()
         try:
             os.unlink(f"/dev/shm/{self.name}")
         except OSError:
             pass
 
     def __reduce__(self):
-        # Cross-process handle: reattach by name.
-        return (Channel, (self.name, self.capacity, False))
+        # Cross-process handle: reattach by name (same slot geometry).
+        return (Channel, (self.name, self.capacity, False, self.slots))
 
 
 class SocketChannel:
     """Single-writer single-reader channel ACROSS HOSTS (the reference's
     aDAG channels run cross-node, ``experimental/channel.py:51``; shm can't).
 
-    Same surface and semantics as :class:`Channel` — write blocks until the
-    previous value was consumed (capacity-1 backpressure), read blocks for
-    the next value — over a TCP stream. Roles bind lazily: the first
-    ``read()`` makes this end the reader (it listens and publishes its
-    address in the control plane's KV under the channel name); the first
-    ``write()`` makes it the writer (it polls the KV and connects). Frames
-    are length-prefixed; each is acked after the consumer's read returns.
+    Same surface and semantics as :class:`Channel` — a ring of in-flight
+    values with backpressure — over a TCP stream with CREDIT-BASED acks:
+    the writer may run ``dag_socket_window`` frames ahead of the reader's
+    acks (the reader acks each frame as its read returns), so burst
+    submission pipelines over the wire instead of stalling on a per-frame
+    ack round-trip. ``window=1`` restores strict lock-step. Roles bind
+    lazily: the first ``read()`` makes this end the reader (it listens and
+    publishes its address in the control plane's KV under the channel
+    name); the first ``write()`` makes it the writer (it polls the KV and
+    connects). Frames are length-prefixed.
     """
 
     _ACK = b"\x06\x00\x00\x00\x00\x00\x00\x01"
 
     def __init__(self, name: Optional[str] = None,
-                 capacity: int = 4 * 1024 * 1024, create: bool = True):
+                 capacity: int = 4 * 1024 * 1024, create: bool = True,
+                 window: Optional[int] = None):
+        from ray_tpu.core.config import config
+
         self.name = name or f"rtpu-schan-{uuid.uuid4().hex[:12]}"
         self.capacity = capacity  # parity with Channel; frames are unbounded
+        self.window = (max(1, int(window)) if window
+                       else max(1, int(config().dag_socket_window)))
         self._sock = None
         self._listener = None
         self._role: Optional[str] = None
         self._unacked = 0
         self._closed = False
+        self._attached_endpoint = not create
 
     # -- rendezvous -----------------------------------------------------------
 
@@ -275,13 +398,14 @@ class SocketChannel:
         import socket as _socket
 
         self._role = "writer"
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while True:
             raw = self._kv().kv_get(f"dag_channel:{self.name}",
                                     namespace="dag")
             if raw:
                 break
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeout(
                     f"reader of {self.name} never published its address")
             time.sleep(0.02)
@@ -323,15 +447,41 @@ class SocketChannel:
             # length-prefixed stream.
             self._sock.settimeout(None)
 
+    def _drain_acks(self) -> None:
+        """Opportunistically consume every ack already on the wire without
+        blocking — the credit-refill half of the windowed protocol. The
+        writer's socket only ever carries acks, so buffered bytes parse as
+        fixed 8-byte frames."""
+        if not hasattr(self, "_rx"):
+            self._rx = bytearray()
+        try:
+            self._sock.settimeout(0.0)
+            while True:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ChannelClosed(self.name)
+                self._rx.extend(chunk)
+        except (BlockingIOError, InterruptedError):
+            pass
+        finally:
+            self._sock.settimeout(None)
+        while len(self._rx) >= 8 and self._unacked > 0:
+            ack = bytes(self._rx[:8])
+            del self._rx[:8]
+            if ack != self._ACK:
+                raise ChannelClosed(self.name)
+            self._unacked -= 1
+
     def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
         self._write_payload(serialization.dumps(value), timeout)
 
     def _write_payload(self, payload: bytes, timeout: Optional[float]) -> None:
         if self._sock is None:
             self._become_writer(timeout)
-        if self._unacked >= 1:
-            # capacity-1 backpressure: wait for the reader to consume the
-            # previous value (its ack) before publishing the next.
+        self._drain_acks()
+        if self._unacked >= self.window:
+            # Window exhausted: block for exactly one credit before
+            # publishing the next frame.
             ack = self._recv_exact(8, timeout)
             if ack != self._ACK:
                 raise ChannelClosed(self.name)
@@ -353,6 +503,8 @@ class SocketChannel:
             pass  # writer gone; the value still counts
         return value
 
+    # -- lifecycle ------------------------------------------------------------
+
     def close(self) -> None:
         if self._closed:
             return
@@ -364,7 +516,9 @@ class SocketChannel:
         except (ChannelTimeout, ChannelClosed, OSError):
             pass
 
-    def destroy(self) -> None:
+    def detach(self) -> None:
+        """Close this endpoint's socket/listener fds without touching the
+        KV registration — the worker-side half of teardown. Idempotent."""
         for s in (self._sock, self._listener):
             if s is not None:
                 try:
@@ -372,10 +526,13 @@ class SocketChannel:
                 except OSError:
                     pass
         self._sock = self._listener = None
+
+    def destroy(self) -> None:
+        self.detach()
         try:
             self._kv().kv_del(f"dag_channel:{self.name}", namespace="dag")
         except Exception:  # noqa: BLE001 — runtime already down
             pass
 
     def __reduce__(self):
-        return (SocketChannel, (self.name, self.capacity, False))
+        return (SocketChannel, (self.name, self.capacity, False, self.window))

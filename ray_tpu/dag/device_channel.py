@@ -10,8 +10,9 @@ this channel is to make that traversal cost ONE device→host DMA, one shm
 landing, and one host→device DMA, with the two directions overlapped:
 
 - the payload is written as dtype/shape header + raw buffer straight into
-  the shm segment (no pickle on either side);
-- TWO shm slots alternate (ping-pong): the writer fills slot ``k+1`` while
+  the shm ring slot (no pickle on either side);
+- the underlying :class:`~ray_tpu.dag.channel.Channel` ring (≥2 slots)
+  generalizes the original ping-pong: the writer fills slot ``k+1`` while
   the reader's host→device upload of slot ``k`` is still in flight, so
   the DMA of one step hides behind the transfer of the next — the
   double-buffering half of the design;
@@ -32,7 +33,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout, HEADER_SIZE
+from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
 
 # Payload kinds inside a slot: raw array (header + buffer) or pickled.
 _KIND_ARRAY = 0
@@ -41,22 +42,25 @@ _META = struct.Struct("<BI")  # kind, header_len
 
 
 class DeviceChannel:
-    """Single-writer single-reader device-tensor channel (ping-pong)."""
+    """Single-writer single-reader device-tensor channel (ring-buffered)."""
 
     def __init__(self, name: Optional[str] = None,
                  capacity: int = 64 * 1024 * 1024, create: bool = True,
-                 device: Any = None, sharding: Any = None):
+                 device: Any = None, sharding: Any = None,
+                 slots: Optional[int] = None):
         self.name = name or f"rtpu-devchan-{uuid.uuid4().hex[:12]}"
         self.capacity = capacity
-        # Two independent seqlock slots; writer/reader alternate in step.
-        self._slots = [
-            Channel(f"{self.name}-p{i}", capacity=capacity, create=create)
-            for i in (0, 1)
-        ]
-        self._wcursor = 0
-        self._rcursor = 0
+        # At least two slots — one in-flight upload + one being filled is
+        # the minimum for the DMA overlap this channel exists for — even
+        # when dag_channel_slots=1 pins plain channels to lock-step.
+        from ray_tpu.dag.channel import _default_slots
+
+        self._ch = Channel(f"{self.name}-ring", capacity=capacity,
+                           create=create,
+                           slots=max(2, slots if slots else _default_slots()))
         self._device = device
         self._sharding = sharding
+        self._attached_endpoint = not create
         # The previous read's device array: its upload must be complete
         # before we ack the slot it came from (deferred ack = the overlap).
         self._pending_ack: Optional[tuple] = None
@@ -64,18 +68,13 @@ class DeviceChannel:
     # -- write ---------------------------------------------------------------
 
     def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
-        # The slot cursor advances ONLY on success: an errored write
-        # (oversized payload, timeout) must leave the ping-pong in step
-        # with the reader or every later value lands one slot off.
-        slot = self._slots[self._wcursor % 2]
         arr = self._as_host_array(value)
         if arr is None:
             from ray_tpu.core import serialization
 
             blob = serialization.dumps(value)
             payload = _META.pack(_KIND_PICKLE, len(blob)) + blob
-            slot._write_payload(payload, timeout)
-            self._wcursor += 1
+            self._ch._write_payload(payload, timeout)
             return
         header = pickle.dumps((arr.dtype.str, arr.shape))
         total = _META.size + len(header) + arr.nbytes
@@ -83,12 +82,13 @@ class DeviceChannel:
             raise ValueError(
                 f"array of {arr.nbytes} bytes exceeds device-channel "
                 f"capacity {self.capacity}")
-        # Write header+buffer directly into the slot's shm region — the
-        # device→host DMA result lands once, no pickle copy.
-        slot._wait_writable(timeout)
+        # Write header+buffer directly into the ring slot's shm region —
+        # the device→host DMA result lands once, no pickle copy.
+        ch = self._ch
+        ch._wait_writable(timeout)
         try:
-            base = HEADER_SIZE
-            mm = slot._mm
+            base = ch._wpayload_off
+            mm = ch._mm
             _META.pack_into(mm, base, _KIND_ARRAY, len(header))
             mm[base + _META.size:base + _META.size + len(header)] = header
             off = base + _META.size + len(header)
@@ -98,10 +98,9 @@ class DeviceChannel:
         except BaseException:
             # Roll the seqlock back to even: a failed fill must not leave
             # the slot marked write-in-progress forever.
-            slot._store_write_seq(slot._pending_write_seq)
+            ch._abort_write()
             raise
-        slot._publish(total)
-        self._wcursor += 1
+        ch._publish(total)
 
     @staticmethod
     def _as_host_array(value) -> Optional[np.ndarray]:
@@ -128,20 +127,19 @@ class DeviceChannel:
         """Next value as a ``jax.Array`` on this channel's device/sharding
         (raw arrays) or the pickled object (control payloads)."""
         self._complete_pending_ack()
-        slot = self._slots[self._rcursor % 2]
-        view, length = slot._read_view(timeout)
-        self._rcursor += 1  # only after a value arrived (cursor-on-success)
+        ch = self._ch
+        view, length, slot, seq = ch._consume_view(timeout)
         kind, hlen = _META.unpack_from(view, 0)
         if kind == _KIND_PICKLE:
             from ray_tpu.core import serialization
 
             blob = bytes(view[_META.size:_META.size + hlen])
-            if slot._load()[0] != slot._pending_read_seq:
+            if ch._load(slot)[0] != seq:
                 # close() force-published over the slot mid-copy; the only
                 # force-publisher is teardown.
-                slot._ack_current()
+                ch._ack(slot, ch._load(slot)[0])
                 raise ChannelClosed(self.name)
-            slot._ack_current()
+            ch._ack(slot, seq)
             value = serialization.loads(blob)
             if isinstance(value, bytes) and value == _CLOSE_SENTINEL:
                 raise ChannelClosed(self.name)
@@ -161,51 +159,60 @@ class DeviceChannel:
             dev_arr = jax.device_put(host)
         # DEFERRED ack: the host→device upload may still be reading the
         # shm bytes; ack only once it lands — usually on the NEXT read,
-        # by which point the writer has been filling the other slot.
-        self._pending_ack = (slot, dev_arr, slot._pending_read_seq)
+        # by which point the writer has been filling the next ring slot.
+        self._pending_ack = (slot, seq, dev_arr)
         return dev_arr
 
     def _complete_pending_ack(self) -> None:
         if self._pending_ack is None:
             return
-        slot, dev_arr, seq = self._pending_ack
+        slot, seq, dev_arr = self._pending_ack
         self._pending_ack = None
         try:
             dev_arr.block_until_ready()
         except Exception:  # noqa: BLE001 — deleted/donated array: DMA done
             pass
-        if slot._load()[0] != seq:
+        if self._ch._load(slot)[0] != seq:
             # A teardown force-publish overwrote the slot while the upload
             # was in flight — the consumer's tensor may be torn. Surface
             # it as the close it is rather than silent corruption.
-            slot._ack_current()
+            self._ch._ack(slot, self._ch._load(slot)[0])
             raise ChannelClosed(self.name)
-        slot._ack_current()
+        self._ch._ack(slot, seq)
 
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
         from ray_tpu.core import serialization
 
-        slot = self._slots[self._wcursor % 2]
-        self._wcursor += 1
         blob = serialization.dumps(_CLOSE_SENTINEL)
         payload = _META.pack(_KIND_PICKLE, len(blob)) + blob
         try:
-            slot._write_payload(payload, timeout=0.5)
+            self._ch._write_payload(payload, timeout=0.5)
         except (ChannelTimeout, ValueError):
             # Force-publish the META-FRAMED pill (the raw underlying pill
             # would be misparsed by this channel's framed read path).
-            slot._force_publish(payload)
+            self._ch._force_publish(payload)
+
+    def _settle(self) -> None:
+        try:
+            self._complete_pending_ack()
+        except ChannelClosed:
+            pass  # teardown overwrote the in-flight slot — expected here
+
+    def detach(self) -> None:
+        """Worker-side endpoint close (no unlink); see Channel.detach."""
+        self._settle()
+        self._ch.detach()
 
     def destroy(self) -> None:
-        self._complete_pending_ack()
-        for s in self._slots:
-            s.destroy()
+        self._settle()
+        self._ch.destroy()
 
     def __reduce__(self):
         return (DeviceChannel, (self.name, self.capacity, False,
-                                self._device, self._sharding))
+                                self._device, self._sharding,
+                                self._ch.slots))
 
 
 _CLOSE_SENTINEL = b"\x00__ray_tpu_device_channel_closed__"

@@ -11,10 +11,12 @@ from ray_tpu.serve.api import (
     grpc_proxy_address,
     proxy_grpc_addresses,
     run,
+    run_pipeline,
     shutdown,
     start_proxies,
     status,
 )
+from ray_tpu.serve.dag_pipeline import PipelineHandle, SequentialPipelineHandle
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
@@ -26,6 +28,9 @@ __all__ = [
     "Deployment",
     "Application",
     "run",
+    "run_pipeline",
+    "PipelineHandle",
+    "SequentialPipelineHandle",
     "shutdown",
     "status",
     "delete",

@@ -19,6 +19,7 @@ from ray_tpu.serve.handle import DeploymentHandle
 
 _proxy = None  # module-level HTTP proxy singleton
 _grpc_proxy = None  # module-level gRPC proxy singleton
+_pipelines: list = []  # live PipelineHandles; torn down in shutdown()
 
 
 def run(
@@ -75,6 +76,74 @@ def run(
             _grpc_proxy = GrpcProxy(controller, port=grpc_port)
             _grpc_proxy.start()
     return ingress
+
+
+def run_pipeline(
+    stages,
+    *,
+    name: str = "pipeline",
+    compiled: bool = True,
+    channel_type: str = "auto",
+    channel_capacity: int = 4 * 1024 * 1024,
+    channel_slots: Optional[int] = None,
+    lanes: Optional[int] = None,
+):
+    """Deploy a LINEAR chain of deployments and return its ingress handle.
+
+    ``stages`` is the chain in data-flow order (each stage's ``__call__``
+    receives the previous stage's return value). With ``compiled=True``
+    (the µs-scale path) the call chain is PRECOMPILED into resident
+    compiled-DAG lanes over the stage replicas — one channel write + read
+    per edge per request instead of a per-stage actor RPC; see
+    ``ray_tpu/serve/dag_pipeline.py`` for the replica-dedication trade-off.
+    With ``compiled=False`` the same chain runs over per-call
+    DeploymentHandles (the A/B baseline). The returned handle's
+    ``.remote(value).result()`` surface is identical either way.
+
+    ``lanes`` bounds the number of parallel compiled lanes (default: one
+    per replica of the smallest stage). ``channel_slots`` overrides the
+    ``dag_channel_slots`` ring depth per edge.
+    """
+    from ray_tpu.serve.dag_pipeline import (SequentialPipelineHandle,
+                                            build_compiled_pipeline)
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = get_or_create_controller()
+    names = []
+    for stage in stages:
+        if isinstance(stage, Application):
+            dep, init_args, init_kwargs = (
+                stage.deployment, stage.init_args, stage.init_kwargs)
+            if any(isinstance(a, Application)
+                   for a in list(init_args) + list(init_kwargs.values())):
+                raise TypeError(
+                    "run_pipeline stages are a linear data-flow chain; "
+                    "composed Application init args belong to serve.run")
+        elif isinstance(stage, Deployment):
+            dep, init_args, init_kwargs = stage, (), {}
+        else:
+            raise TypeError(
+                "run_pipeline stages must be Deployments (or their bound "
+                f"Applications), got {type(stage).__name__}")
+        ray_tpu.get(
+            controller.deploy.remote(
+                dep.name, dep.func_or_class, init_args, init_kwargs,
+                dep.config, None
+            )
+        )
+        names.append(dep.name)
+    _wait_ready(controller, names)
+    if not compiled:
+        return SequentialPipelineHandle(
+            names, [DeploymentHandle(n, controller) for n in names])
+    handle = build_compiled_pipeline(
+        controller, names, channel_type=channel_type,
+        channel_capacity=channel_capacity, channel_slots=channel_slots,
+        lanes=lanes)
+    handle._registry = _pipelines
+    _pipelines.append(handle)
+    return handle
 
 
 def grpc_proxy_address() -> Optional[str]:
@@ -160,6 +229,16 @@ def delete(deployment_name: str) -> None:
 
 def shutdown() -> None:
     global _proxy, _grpc_proxy, _proxy_manager
+    # Pipelines first: their replicas are PARKED in resident DAG loops and
+    # only exit on the close pill — killing the controller/replicas before
+    # teardown would orphan the loops mid-read.
+    while _pipelines:
+        try:
+            _pipelines.pop().shutdown()
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            from ray_tpu.utils.logging import get_logger, log_swallowed
+
+            log_swallowed(get_logger("serve"), "pipeline shutdown")
     if _proxy_manager is not None:
         try:
             _proxy_manager.shutdown()

@@ -94,6 +94,30 @@ class ReplicaActor:
             serve_request_hist().observe(
                 elapsed_s, {"deployment": self.deployment_name})
 
+    def dag_call(self, value):
+        """Single-arg data-plane entry for PRECOMPILED pipeline DAGs
+        (serve.run_pipeline(compiled=True)): the replica parks in a
+        resident compiled-DAG loop reading this method's input from a
+        mutable channel instead of taking per-request actor RPCs. Keeps
+        the same ongoing/total bookkeeping and latency histogram as
+        handle_request so autoscaling metrics and dashboards stay
+        truthful."""
+        import asyncio
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        started = time.monotonic()
+        try:
+            result = self._resolve_method("__call__")(value)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+            self._observe_latency(time.monotonic() - started)
+
     def handle_request_streaming(self, method_name: str, *args, **kwargs):
         """Generator method: yields items (streamed via ObjectRefGenerator)."""
         from ray_tpu.serve import multiplex

@@ -11,7 +11,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.dag import Channel, ChannelClosed, ChannelTimeout, InputNode
+from ray_tpu.dag import (Channel, ChannelClosed, ChannelTimeout, InputNode,
+                         MultiOutputNode)
 
 
 class TestChannel:
@@ -24,7 +25,8 @@ class TestChannel:
             ch.destroy()
 
     def test_backpressure_blocks_second_write(self):
-        ch = Channel(capacity=1 << 16)
+        # slots=1 restores the strict capacity-1 lock-step channel.
+        ch = Channel(capacity=1 << 16, slots=1)
         try:
             ch.write(1)
             with pytest.raises(ChannelTimeout):
@@ -32,6 +34,30 @@ class TestChannel:
             assert ch.read(timeout=5) == 1
             ch.write(2)  # now the slot is free
             assert ch.read(timeout=5) == 2
+        finally:
+            ch.destroy()
+
+    def test_ring_pipelines_n_writes_then_backpressures(self):
+        ch = Channel(capacity=1 << 16, slots=4)
+        try:
+            for i in range(4):  # the whole ring fills without a reader
+                ch.write(i, timeout=5)
+            with pytest.raises(ChannelTimeout):
+                ch.write(99, timeout=0.2)  # slot 0 still unacked
+            assert ch.read(timeout=5) == 0  # one ack frees one slot
+            ch.write(4, timeout=5)
+            assert [ch.read(timeout=5) for _ in range(4)] == [1, 2, 3, 4]
+        finally:
+            ch.destroy()
+
+    def test_ring_fifo_across_wraparound(self):
+        ch = Channel(capacity=1 << 16, slots=3)
+        try:
+            out = []
+            for i in range(11):  # > 3 full ring revolutions
+                ch.write(i, timeout=5)
+                out.append(ch.read(timeout=5))
+            assert out == list(range(11))
         finally:
             ch.destroy()
 
@@ -151,6 +177,315 @@ class TestCompiledDAG:
             cluster.shutdown()
 
 
+class TestFanOutFanIn:
+    """Graph shapes beyond linear chains (reference: multi-arg bind +
+    MultiOutputNode in python/ray/dag)."""
+
+    def test_diamond_matches_plain_calls(self, ray_start_regular):
+        """input → pre → (left, right) → merge: per-edge channels, fan-out
+        broadcast, fan-in gather — result identical to the task path."""
+
+        @ray_tpu.remote
+        class Pre:
+            def apply(self, x):
+                return x + 1
+
+        @ray_tpu.remote
+        class Left:
+            def apply(self, x):
+                return x * 2
+
+        @ray_tpu.remote
+        class Right:
+            def apply(self, x):
+                return x * 3
+
+        @ray_tpu.remote
+        class Merge:
+            def apply(self, a, b):
+                return (a, b)
+
+        pre, lt, rt, mg = Pre.remote(), Left.remote(), Right.remote(), Merge.remote()
+        with InputNode() as inp:
+            p = pre.apply.bind(inp)
+            dag = mg.apply.bind(lt.apply.bind(p), rt.apply.bind(p))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(10):
+                assert compiled.execute(i).get(timeout=30) == \
+                    ((i + 1) * 2, (i + 1) * 3)
+        finally:
+            compiled.teardown()
+
+    def test_multi_output_node_yields_tuples(self, ray_start_regular):
+        @ray_tpu.remote
+        class Double:
+            def apply(self, x):
+                return x * 2
+
+        @ray_tpu.remote
+        class Square:
+            def apply(self, x):
+                return x * x
+
+        d, s = Double.remote(), Square.remote()
+        with InputNode() as inp:
+            dag = MultiOutputNode([d.apply.bind(inp), s.apply.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(6)]
+            assert [r.get(timeout=30) for r in refs] == \
+                [(i * 2, i * i) for i in range(6)]
+        finally:
+            compiled.teardown()
+
+    def test_constant_bind_args(self, ray_start_regular):
+        @ray_tpu.remote
+        class AffineOp:
+            def apply(self, x, scale, offset):
+                return x * scale + offset
+
+        a = AffineOp.remote()
+        compiled = a.apply.bind(InputNode(), 10, 7).experimental_compile()
+        try:
+            assert compiled.execute(3).get(timeout=30) == 37
+        finally:
+            compiled.teardown()
+
+    def test_fan_in_error_passes_through_merge(self, ray_start_regular):
+        """An upstream failure forwards through downstream stages so the
+        driver sees the ORIGINATING stage's error, and the DAG survives."""
+
+        @ray_tpu.remote
+        class Fragile:
+            def apply(self, x):
+                if x == 13:
+                    raise ValueError("unlucky-upstream")
+                return x
+
+        @ray_tpu.remote
+        class Stable:
+            def apply(self, x):
+                return x
+
+        @ray_tpu.remote
+        class Merge:
+            def apply(self, a, b):
+                return a + b
+
+        f, s, m = Fragile.remote(), Stable.remote(), Merge.remote()
+        with InputNode() as inp:
+            dag = m.apply.bind(f.apply.bind(inp), s.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get(timeout=30) == 2
+            with pytest.raises(RuntimeError, match="unlucky-upstream"):
+                compiled.execute(13).get(timeout=30)
+            assert compiled.execute(2).get(timeout=30) == 4
+        finally:
+            compiled.teardown()
+
+
+class TestBurstPipelining:
+    def test_burst_fifo_and_index_mapping(self, ray_start_regular):
+        """>1 tick in flight per edge: a burst submitted before any fetch
+        drains FIFO, and DAGRef index→result mapping holds under
+        out-of-order gets."""
+
+        @ray_tpu.remote
+        class Sq:
+            def apply(self, x):
+                return x * x
+
+        s = Sq.remote()
+        compiled = s.apply.bind(InputNode()).experimental_compile(
+            channel_slots=4)
+        try:
+            # 8 in flight = the full pipeline capacity at slots=4 (input
+            # ring + output ring); a capacity-1 design would deadlock here
+            # because execute() #2 already needs the driver to fetch.
+            refs = [compiled.execute(i) for i in range(8)]
+            # Fetch out of order: late index first forces the FIFO drain
+            # to buffer earlier results; each ref must still map to ITS
+            # tick.
+            assert refs[7].get(timeout=30) == 49
+            assert refs[0].get(timeout=30) == 0
+            assert [refs[i].get(timeout=30) for i in (6, 3, 5)] == \
+                [36, 9, 25]
+            assert [r.get(timeout=30) for r in refs] == \
+                [i * i for i in range(8)]
+        finally:
+            compiled.teardown()
+
+    def test_teardown_under_load(self, ray_start_regular):
+        """Teardown with unfetched in-flight ticks: the drain must let the
+        stage loops exit on the pill (no mid-read unlink), leaving the
+        loop refs completed."""
+
+        @ray_tpu.remote
+        class Slowish:
+            def apply(self, x):
+                time.sleep(0.005)
+                return x
+
+        s = Slowish.remote()
+        compiled = s.apply.bind(InputNode()).experimental_compile(
+            channel_slots=4)
+        refs = [compiled.execute(i) for i in range(4)]
+        assert refs[0].get(timeout=30) == 0
+        compiled.teardown()  # 3 ticks never fetched
+        # The resident loops saw the pill and exited cleanly.
+        assert ray_tpu.get(compiled._loop_refs, timeout=30) == ["closed"]
+        with pytest.raises(RuntimeError, match="torn down"):
+            compiled.execute(99)
+
+    def test_partial_multi_output_gather_survives_timeout(
+            self, ray_start_regular):
+        """A get() that times out after consuming SOME leaves of a
+        MultiOutputNode tick must not lose them: the retry resumes at the
+        first unread leaf and every later tick's tuple stays aligned."""
+
+        @ray_tpu.remote
+        class Fast:
+            def apply(self, x):
+                return ("fast", x)
+
+        @ray_tpu.remote
+        class Slow:
+            def apply(self, x):
+                time.sleep(0.4)
+                return ("slow", x)
+
+        f, s = Fast.remote(), Slow.remote()
+        with InputNode() as inp:
+            dag = MultiOutputNode([f.apply.bind(inp), s.apply.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            ref0 = compiled.execute(0)
+            # Fast's leaf is consumed, then Slow's read times out.
+            with pytest.raises(ChannelTimeout):
+                ref0.get(timeout=0.1)
+            ref1 = compiled.execute(1)
+            assert ref0.get(timeout=30) == (("fast", 0), ("slow", 0))
+            assert ref1.get(timeout=30) == (("fast", 1), ("slow", 1))
+        finally:
+            compiled.teardown()
+
+    def test_partial_input_write_rolls_back_on_timeout(
+            self, ray_start_regular):
+        """execute() hitting backpressure on ONE fan-out input edge must
+        publish to NO edge: without the two-phase commit the fast sibling
+        edge runs a tick ahead and every later merge mixes ticks."""
+
+        @ray_tpu.remote
+        class Fast:
+            def apply(self, x):
+                return x
+
+        @ray_tpu.remote
+        class Slow:
+            def apply(self, x):
+                time.sleep(0.25)
+                return x
+
+        @ray_tpu.remote
+        class Merge:
+            def apply(self, a, b):
+                assert a == b, (a, b)  # tick alignment invariant
+                return a
+
+        f, s, m = Fast.remote(), Slow.remote(), Merge.remote()
+        with InputNode() as inp:
+            # Fast bound FIRST: its input edge is written before Slow's,
+            # which is the order that desyncs without rollback.
+            dag = m.apply.bind(f.apply.bind(inp), s.apply.bind(inp))
+        compiled = dag.experimental_compile(channel_slots=1)
+        try:
+            refs = [compiled.execute(i, timeout=10) for i in range(2)]
+            # Slow is busy with tick 0, its 1-slot input ring holds tick 1
+            # -> this execute must time out WITHOUT feeding Fast's edge.
+            with pytest.raises(ChannelTimeout):
+                compiled.execute(99, timeout=0.1)
+            assert [r.get(timeout=30) for r in refs] == [0, 1]
+            # Post-timeout ticks stay aligned (Merge asserts a == b).
+            refs2 = [compiled.execute(i, timeout=30) for i in (5, 6)]
+            assert [r.get(timeout=30) for r in refs2] == [5, 6]
+        finally:
+            compiled.teardown()
+
+    def test_dag_tick_histogram_records(self, ray_start_regular):
+        from ray_tpu.core.metrics_export import dag_tick_hist
+
+        @ray_tpu.remote
+        class Echo:
+            def apply(self, x):
+                return x
+
+        e = Echo.remote()
+        compiled = e.apply.bind(InputNode()).experimental_compile()
+        try:
+            before = sum(dag_tick_hist()._totals.values())
+            for i in range(5):
+                assert compiled.execute(i).get(timeout=30) == i
+            after = sum(dag_tick_hist()._totals.values())
+            assert after - before == 5
+        finally:
+            compiled.teardown()
+
+
+class TestWorkerChannelLifecycle:
+    def test_worker_detaches_channel_fds_on_loop_exit(self):
+        """The worker-side leak fix: a stage worker's attached channel
+        endpoints (mmap + backing fd per channel) are closed when its
+        resident loop exits at teardown — previously every compiled DAG
+        leaked two fds per stage worker, forever."""
+        from ray_tpu.core import runtime as runtime_mod
+        from ray_tpu.core.cluster import Cluster, connect
+
+        cluster = Cluster(num_nodes=1, resources_per_node={"CPU": 3})
+        try:
+            core = connect(cluster.gcs_address)
+            try:
+                @ray_tpu.remote
+                class Probe:
+                    def apply(self, x):
+                        return x
+
+                    def chan_fds(self):
+                        import os as _os
+
+                        n = 0
+                        for fd in _os.listdir("/proc/self/fd"):
+                            try:
+                                tgt = _os.readlink(f"/proc/self/fd/{fd}")
+                            except OSError:
+                                continue
+                            if "rtpu-chan" in tgt or "rtpu-schan" in tgt \
+                                    or "rtpu-devchan" in tgt:
+                                n += 1
+                        return n
+
+                a, b = Probe.remote(), Probe.remote()
+                ray_tpu.get([a.apply.remote(0), b.apply.remote(0)],
+                            timeout=120)
+                for _round in range(2):  # repeated compiles must not accrete
+                    dag = b.apply.bind(a.apply.bind(InputNode()))
+                    compiled = dag.experimental_compile()
+                    try:
+                        assert compiled.execute(7).get(timeout=60) == 7
+                    finally:
+                        compiled.teardown()
+                # The loops exited and detached: no channel-backed fds
+                # survive in either stage worker.
+                assert ray_tpu.get(a.chan_fds.remote(), timeout=60) == 0
+                assert ray_tpu.get(b.chan_fds.remote(), timeout=60) == 0
+            finally:
+                core.shutdown()
+                runtime_mod._global_runtime = None
+        finally:
+            cluster.shutdown()
+
+
 class TestCompiledDAGValidation:
     def test_same_actor_twice_rejected(self, ray_start_regular):
         @ray_tpu.remote
@@ -219,6 +554,84 @@ class TestSocketChannels:
         t.join(timeout=30)
         assert reader_out == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3},
                               {"i": 4}, "closed"]
+
+    def test_windowed_acks_pipeline_writes(self, ray_start_regular):
+        """Credit-based flow control: the writer runs a full window of
+        frames ahead of the reader's acks (the capacity-1 design stalled
+        on an ack round-trip per frame), then blocks on credit
+        exhaustion."""
+        import threading
+
+        from ray_tpu.dag.channel import SocketChannel
+
+        ch = SocketChannel(window=4)
+        ch_reader = SocketChannel(ch.name, create=False)
+        started = threading.Event()
+
+        def accept_only():
+            # Bind the reader role (so the writer can connect) but DON'T
+            # read yet — no acks flow.
+            ch_reader._become_reader(timeout=30)
+            started.set()
+
+        t = threading.Thread(target=accept_only)
+        t.start()
+        try:
+            # A full window of writes completes with ZERO acks on the wire.
+            for i in range(4):
+                ch.write({"i": i}, timeout=10)
+            started.wait(10)
+            # The 5th blocks on credit exhaustion...
+            with pytest.raises(ChannelTimeout):
+                ch.write({"i": 4}, timeout=0.3)
+            # ...until the reader drains one frame (one ack = one credit).
+            assert ch_reader.read(timeout=10) == {"i": 0}
+            ch.write({"i": 4}, timeout=10)
+            assert [ch_reader.read(timeout=10) for _ in range(4)] == \
+                [{"i": i} for i in range(1, 5)]
+        finally:
+            t.join(timeout=10)
+            ch.destroy()
+            ch_reader.destroy()
+
+    @pytest.mark.slow
+    def test_socket_dag_burst_pipelining_multidaemon(self):
+        """Cross-daemon compiled DAG over FORCED socket channels: a burst
+        submitted ahead of any fetch pipelines through the windowed acks
+        and drains FIFO (the per-frame-ack design serialized this)."""
+        from ray_tpu.core import runtime as runtime_mod
+        from ray_tpu.core.cluster import Cluster, connect
+
+        cluster = Cluster(num_nodes=2, resources_per_node={"CPU": 2})
+        try:
+            core = connect(cluster.gcs_address)
+            try:
+                @ray_tpu.remote
+                class AddOne:
+                    def apply(self, x):
+                        return x + 1
+
+                @ray_tpu.remote
+                class Double:
+                    def apply(self, x):
+                        return x * 2
+
+                a, d = AddOne.remote(), Double.remote()
+                ray_tpu.get([a.apply.remote(0), d.apply.remote(0)],
+                            timeout=120)
+                dag = d.apply.bind(a.apply.bind(InputNode()))
+                compiled = dag.experimental_compile(channel_type="socket")
+                try:
+                    refs = [compiled.execute(i) for i in range(12)]
+                    assert [r.get(timeout=60) for r in refs] == \
+                        [(i + 1) * 2 for i in range(12)]
+                finally:
+                    compiled.teardown()
+            finally:
+                core.shutdown()
+                runtime_mod._global_runtime = None
+        finally:
+            cluster.shutdown()
 
     def test_compiled_dag_over_sockets_multiprocess(self):
         """A 2-stage compiled DAG with FORCED socket channels across real
