@@ -1,0 +1,215 @@
+"""Continuous-batching LLM serving benchmark (ISSUE 9 tentpole metric).
+
+A/B of the slotted continuous-batching ``LLMEngine`` against the same engine
+pinned to one slot (the batch-1 replica baseline it replaced): aggregate
+tokens/s and client-observed p50/p99 TTFT at concurrency 1/4/16 on the same
+box. Clients are threads issuing sequential streaming ``generate`` calls —
+the same call pattern a Serve replica sees from its actor threads — so the
+numbers include scheduler + admission overhead, not just device time.
+
+``--quick`` is the serve smoke path: it additionally deploys the engine
+through ``llm_deployment`` and streams concurrent requests over the full
+data plane (handle → pow-2 router → replica), checking the streaming
+response contract end to end.
+
+Usage:: python benches/serve_llm.py [--quick] [--round 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+PROMPT_LEN = 8
+NEW_TOKENS = 48  # prompt bucket 16 + 48 decode == tiny max_seq_len 64
+
+
+def _prompt(client: int, rep: int) -> List[int]:
+    return [(client * 31 + rep * 7 + j) % 250 + 1 for j in range(PROMPT_LEN)]
+
+
+def bench_engine(eng, concurrency: int, reps: int) -> dict:
+    """Drive one engine with ``concurrency`` client threads, each streaming
+    ``reps`` sequential requests; returns aggregate tokens/s + TTFT tails."""
+    ttfts: List[float] = []
+    counts = [0] * concurrency
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+
+    def client(i: int) -> None:
+        try:
+            for r in range(reps):
+                t0 = time.perf_counter()
+                first = None
+                for _tok in eng.stream(_prompt(i, r),
+                                       max_new_tokens=NEW_TOKENS):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    counts[i] += 1
+                with lock:
+                    ttfts.append(first)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,), name=f"cli-{i}")
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return {
+        "requests": concurrency * reps,
+        "tokens": sum(counts),
+        "tokens_per_s": round(sum(counts) / wall, 1),
+        "ttft_ms_p50": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "ttft_ms_p99": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+    }
+
+
+def bench_modes(concurrencies, reps: int, slots: int, chunk: int) -> List[dict]:
+    import jax
+
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import LLMEngine
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    cfg = (transformer.gpt2_small(max_seq_len=256) if on_tpu
+           else transformer.tiny(max_seq_len=64))
+    params = transformer.init_params(cfg, jax.random.key(0))
+
+    results = []
+    # max_queue=0: no admission shedding — the A/B measures throughput of
+    # admitted work, and the baseline must accept the same request count.
+    engines = {
+        "batch1": LLMEngine(params, cfg, chunk=chunk, slots=1,
+                            max_queue=0, name="bench-b1"),
+        "continuous": LLMEngine(params, cfg, chunk=chunk, slots=slots,
+                                max_queue=0, name="bench-cb"),
+    }
+    for eng in engines.values():
+        eng.warmup()
+    base_tps = {}
+    for conc in concurrencies:
+        for mode, eng in engines.items():
+            row = {
+                "metric": "serve_llm",
+                "mode": mode,
+                "slots": eng.slots,
+                "chunk": chunk,
+                "concurrency": conc,
+                "new_tokens": NEW_TOKENS,
+                **bench_engine(eng, conc, reps),
+                "platform": "tpu" if on_tpu else "cpu",
+            }
+            if mode == "batch1":
+                base_tps[conc] = row["tokens_per_s"]
+            else:
+                row["speedup_vs_batch1"] = round(
+                    row["tokens_per_s"] / base_tps[conc], 2)
+            print(json.dumps(row), flush=True)
+            results.append(row)
+    return results
+
+
+def smoke_dataplane(concurrency: int = 4, reps: int = 2) -> dict:
+    """Serve smoke: stream concurrent requests through the FULL data plane
+    (handle → router → replica actor → engine) and check the contract."""
+    import jax
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import llm_deployment
+
+    cfg = transformer.tiny(max_seq_len=64)
+    LM = llm_deployment(
+        cfg, lambda: transformer.init_params(cfg, jax.random.key(0)),
+        name="LM", slots=4, chunk=4)
+
+    ray_tpu.init()
+    handle = serve.run(LM.bind())
+    counts = [0] * concurrency
+    errors: List[BaseException] = []
+
+    def client(i: int) -> None:
+        try:
+            for r in range(reps):
+                last = None
+                for item in handle.options(stream=True).remote(
+                        {"prompt_ids": _prompt(i, r), "max_new_tokens": 8}):
+                    assert {"token", "index", "decode_tps"} <= set(item)
+                    counts[i] += 1
+                    last = item
+                assert last is not None and "finish_reason" in last
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+    ray_tpu.shutdown()
+    if errors:
+        raise errors[0]
+    row = {
+        "metric": "serve_llm_dataplane_smoke",
+        "concurrency": concurrency,
+        "tokens": sum(counts),
+        "tokens_per_s": round(sum(counts) / wall, 1),
+        "ok": True,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="CI smoke: short engine A/B + data-plane check")
+    parser.add_argument("--reps", type=int, default=8,
+                        help="sequential requests per client thread")
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--chunk", type=int, default=8)
+    parser.add_argument("--round", type=int, default=0,
+                        help="write BENCH_serve_rNN.json at repo root")
+    args = parser.parse_args()
+
+    if args.quick:
+        results = bench_modes([4], reps=2, slots=4, chunk=args.chunk)
+        results.append(smoke_dataplane())
+    else:
+        results = bench_modes([1, 4, 16], reps=args.reps,
+                              slots=args.slots, chunk=args.chunk)
+
+    if args.round:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            f"BENCH_serve_r{args.round:02d}.json")
+        existing = []
+        if os.path.exists(path):
+            with open(path) as f:
+                existing = json.load(f).get("results", [])
+        with open(path, "w") as f:
+            json.dump({"results": existing + results}, f, indent=1)
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

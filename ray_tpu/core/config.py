@@ -218,6 +218,24 @@ class Config:
     # see its backing file vanish).
     dag_teardown_timeout_s = _Flag(10.0)
 
+    # -- serve / LLM engine ---------------------------------------------------
+    # KV-cache slots per continuous-batching LLM engine (serve/llm.py): how
+    # many sequences decode together in one batched dispatch. More slots =
+    # more MXU-friendly matmul batch and higher aggregate tokens/s, at
+    # slots x max_len x layers KV-cache HBM.
+    serve_llm_slots = _Flag(4)
+    # Prefill token budget per engine iteration: new prompts are admitted
+    # into free slots until their padded lengths exceed this, so a burst of
+    # long prompts can't starve the in-flight decode (the prefill/decode
+    # interleave policy). At least one prompt is always admitted when a
+    # slot is free, so the budget bounds batching, never progress.
+    serve_llm_prefill_tokens = _Flag(128)
+    # Admission-control shed threshold: a request arriving while this many
+    # are already waiting for a slot fails FAST with serve.Saturated instead
+    # of queueing unboundedly (the router also sheds when every replica
+    # reports a queue this deep). 0 disables shedding.
+    serve_admission_queue_limit = _Flag(32)
+
     # -- metrics / observability ----------------------------------------------
     # Cluster-wide metrics pipeline: every process (gcs_server, node_daemon,
     # worker, driver) runs an exporter thread that snapshots its

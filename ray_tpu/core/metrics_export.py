@@ -99,6 +99,19 @@ def serve_request_hist() -> um.Histogram:
         tag_keys=("deployment",))
 
 
+def serve_ttft_hist() -> um.Histogram:
+    return _metric(
+        um.Histogram, "ray_tpu_serve_ttft_s",
+        "LLM serving time-to-first-token (request submit to first token)",
+        boundaries=_LATENCY_BOUNDS, tag_keys=("deployment",))
+
+
+def serve_tokens_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_serve_tokens_total",
+                   "LLM serving decoded tokens delivered to requests",
+                   tag_keys=("deployment",))
+
+
 def dag_tick_hist() -> um.Histogram:
     return _metric(
         um.Histogram, "ray_tpu_dag_tick_s",

@@ -20,6 +20,7 @@ from ray_tpu.serve.dag_pipeline import PipelineHandle, SequentialPipelineHandle
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.errors import Saturated
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
@@ -39,6 +40,7 @@ __all__ = [
     "DeploymentResponse",
     "AutoscalingConfig",
     "DeploymentConfig",
+    "Saturated",
     "batch",
     "multiplexed",
     "get_multiplexed_model_id",

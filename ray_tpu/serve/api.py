@@ -245,6 +245,11 @@ def shutdown() -> None:
         except Exception:
             pass
         _proxy_manager = None
+    # Stop @serve.batch flusher threads (they'd otherwise wait out their
+    # batch window); queued items flush, and a later submit restarts them.
+    from ray_tpu.serve import batching
+
+    batching.shutdown_all()
     if _proxy is not None:
         _proxy.stop()
         _proxy = None

@@ -38,9 +38,15 @@ class DeploymentConfig:
     user_config: Optional[Dict] = None
     health_check_period_s: float = 10.0
     graceful_shutdown_timeout_s: float = 5.0
+    # Replica actor thread pool: >1 runs requests concurrently inside ONE
+    # replica (threaded actor) — required for engines that batch concurrent
+    # streams (serve/llm.py continuous batching).
+    max_concurrency: int = 1
 
     def __post_init__(self):
         if self.num_replicas < 0:
             raise ValueError("num_replicas must be >= 0")
         if self.max_ongoing_requests <= 0:
             raise ValueError("max_ongoing_requests must be > 0")
+        if self.max_concurrency <= 0:
+            raise ValueError("max_concurrency must be > 0")

@@ -50,6 +50,9 @@ class ServeControllerActor:
         self._last_downscale: Dict[str, float] = {}
         # deployment -> {replica key -> loaded multiplexed model ids}
         self._model_ids: Dict[str, Dict[str, list]] = {}
+        # deployment -> {replica key -> metrics dict (ongoing, slot
+        # occupancy, queue depth, ...)} — the routers' occupancy signal.
+        self._replica_load: Dict[str, Dict[str, dict]] = {}
         self._model_poll_tick = 0
         # Rolling updates: old-version replicas keep serving until the new
         # version is fully up, then retire here — excluded from routing,
@@ -166,6 +169,10 @@ class ServeControllerActor:
                     "route_prefix": t.route_prefix,
                     # model-aware routing (pow_2_scheduler.py:127-135)
                     "model_ids": dict(self._model_ids.get(name, {})),
+                    # KV-occupancy-aware routing + admission shedding:
+                    # last-polled per-replica metrics (slots_busy,
+                    # queue_depth, ...). Advisory — may lag the poll period.
+                    "replica_load": dict(self._replica_load.get(name, {})),
                 }
             return self._version, table
 
@@ -188,34 +195,44 @@ class ServeControllerActor:
             time.sleep(0.05)
 
     def _poll_multiplexed_ids(self):
-        """Collect each replica's loaded model set (the reference pushes
-        from replicas via record_multiplexed_model_ids; polling keeps the
-        replica surface passive). A replica that doesn't answer in time —
-        e.g. serially busy with a long inference — KEEPS its last-known
-        entry: stale warm-routing info beats flapping the routers' tables
-        exactly when the replica is loaded. Version bump on change
-        re-triggers the routers' long-poll."""
+        """Collect each replica's loaded model set AND load metrics in one
+        ``get_state`` RPC (the reference pushes from replicas via
+        record_multiplexed_model_ids; polling keeps the replica surface
+        passive). A replica that doesn't answer in time — e.g. serially busy
+        with a long inference — KEEPS its last-known entry: stale
+        warm-routing info beats flapping the routers' tables exactly when
+        the replica is loaded. Model-set changes bump the long-poll version;
+        pure load changes do NOT (load flaps every poll — routers pick it up
+        on their next periodic refresh instead of long-poll churn)."""
         with self._lock:
             replicas = {n: list(rs) for n, rs in self._replicas.items()}
         changed = False
         for name, pairs in replicas.items():
             with self._lock:
                 table = dict(self._model_ids.get(name, {}))
+            load: Dict[str, dict] = {}
             live_keys = set()
             for _v, replica in pairs:
                 key = replica.actor_id.hex()
                 live_keys.add(key)
                 try:
-                    ids = ray_tpu.get(
-                        replica.multiplexed_model_ids.remote(), timeout=0.5)
+                    state = ray_tpu.get(
+                        replica.get_state.remote(), timeout=0.5)
                 except Exception:  # noqa: BLE001 — busy or mid-restart:
                     continue       # keep the previous entry
+                ids = state.get("model_ids") or []
                 if ids:
                     table[key] = ids
                 else:
                     table.pop(key, None)
+                load[key] = state.get("metrics", {})
             table = {k: v for k, v in table.items() if k in live_keys}
             with self._lock:
+                prev_load = self._replica_load.get(name, {})
+                # Keep last-known load for replicas that didn't answer.
+                kept = {k: v for k, v in prev_load.items()
+                        if k in live_keys and k not in load}
+                self._replica_load[name] = {**kept, **load}
                 if self._model_ids.get(name) != table:
                     self._model_ids[name] = table
                     changed = True
@@ -283,6 +300,10 @@ class ServeControllerActor:
                     actor_opts["num_tpus"] = opts.pop("num_tpus")
                 if "resources" in opts:
                     actor_opts["resources"] = opts.pop("resources")
+                if t.config.max_concurrency > 1:
+                    # Threaded replica: concurrent streams run inside one
+                    # actor (continuous-batching engines need this).
+                    actor_opts["max_concurrency"] = t.config.max_concurrency
                 replica_cls = ray_tpu.remote(ReplicaActor)
                 replica = replica_cls.options(**actor_opts).remote(
                     name,

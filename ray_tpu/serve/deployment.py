@@ -26,7 +26,7 @@ class Deployment:
         cfg_fields = {
             "num_replicas", "max_ongoing_requests", "autoscaling_config",
             "ray_actor_options", "user_config", "health_check_period_s",
-            "graceful_shutdown_timeout_s",
+            "graceful_shutdown_timeout_s", "max_concurrency",
         }
         cfg_updates = {k: v for k, v in kwargs.items() if k in cfg_fields}
         asc = cfg_updates.get("autoscaling_config")
@@ -76,6 +76,7 @@ def deployment(
     ray_actor_options: Optional[Dict] = None,
     user_config: Optional[Dict] = None,
     route_prefix: Optional[str] = None,
+    max_concurrency: int = 1,
 ):
     """``@serve.deployment`` (reference: ``serve/api.py``)."""
 
@@ -96,6 +97,7 @@ def deployment(
             autoscaling_config=asc_final,
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
+            max_concurrency=max_concurrency,
         )
         return Deployment(
             target, name or target.__name__, cfg, route_prefix=route_prefix

@@ -1,0 +1,18 @@
+"""Serve data-plane errors.
+
+Analog of the reference's ``python/ray/serve/exceptions.py``: typed errors
+the router/engine raise so callers can distinguish "back off and retry"
+(:class:`Saturated`) from a real failure.
+"""
+
+from __future__ import annotations
+
+
+class Saturated(RuntimeError):
+    """Admission control shed: every candidate replica's admission queue is
+    over ``serve_admission_queue_limit`` (or this engine's ``max_queue``).
+
+    Raised FAST — instead of queueing unboundedly — so the caller can apply
+    its own backpressure (retry with jitter, shed upstream, scale out). The
+    request was NOT started; retrying is always safe.
+    """

@@ -100,6 +100,9 @@ class Router:
         self._replicas: List[Any] = []
         self._max_ongoing = 100
         self._model_ids: Dict[str, list] = {}  # replica key -> loaded models
+        # replica key -> controller-polled load metrics (slots_busy,
+        # queue_depth, ...) — advisory, may lag by a poll period.
+        self._replica_load: Dict[str, dict] = {}
         self._ongoing: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
@@ -122,6 +125,7 @@ class Router:
                     self._replicas = entry["replicas"]
                     self._max_ongoing = entry["max_ongoing_requests"]
                     self._model_ids = entry.get("model_ids", {})
+                    self._replica_load = entry.get("replica_load", {})
                 self._last_refresh = now
                 return
             if not block or time.monotonic() > deadline:
@@ -137,12 +141,48 @@ class Router:
             if key in self._ongoing:
                 self._ongoing[key] = max(0, self._ongoing[key] - 1)
 
+    def _slots_exhausted(self, key: str) -> bool:
+        """True when the replica REPORTS a full slot set (engines exporting
+        slot occupancy via get_engine_stats). Unknown/plain replicas are
+        never exhausted — routing degrades to pure pow-2 on ongoing."""
+        load = self._replica_load.get(key)
+        if not load:
+            return False
+        total = load.get("slots_total", 0)
+        return total > 0 and load.get("slots_busy", 0) >= total
+
+    def _all_shedding(self, replicas) -> bool:
+        """Admission control: shed (fast Saturated) only when EVERY replica
+        reports an admission queue at/over ``serve_admission_queue_limit`` —
+        a replica with headroom, or one that doesn't report a queue at all
+        (non-engine deployments), keeps the blocking-queue behavior."""
+        from ray_tpu.core.config import config
+
+        try:
+            limit = config().serve_admission_queue_limit
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            return False
+        if not limit or not replicas:
+            return False
+        for r in replicas:
+            load = self._replica_load.get(self._key(r))
+            if not load or load.get("queue_depth") is None:
+                return False
+            if load["queue_depth"] < limit:
+                return False
+        return True
+
     def _pick(self, model_id: str = ""):
-        """Pow-2: sample two replicas, choose the lower client-side queue.
-        With a ``model_id``, replicas that already hold the model are
-        preferred (pow_2_scheduler.py:127-135) — cold replicas only load it
-        when every warm one is saturated. Blocks (with periodic refresh)
-        while all candidates are saturated."""
+        """Pow-2: sample two replicas, choose the lower client-side queue —
+        replicas reporting FREE KV slots beat replicas reporting a full slot
+        set (occupancy-aware tie-break ahead of the ongoing count). With a
+        ``model_id``, replicas that already hold the model are preferred
+        (pow_2_scheduler.py:127-135) — cold replicas only load it when every
+        warm one is saturated. Blocks (with periodic refresh) while all
+        candidates are saturated, unless every replica also reports an
+        over-limit admission queue — then sheds with ``Saturated``."""
+        from ray_tpu.serve.errors import Saturated
+
         deadline = time.monotonic() + 60.0
         while True:
             self._refresh()
@@ -152,6 +192,10 @@ class Router:
                     k for k, ids in self._model_ids.items() if model_id in ids
                 } if model_id else set()
             if replicas:
+                if self._all_shedding(replicas):
+                    raise Saturated(
+                        f"deployment {self._name}: every replica's admission "
+                        "queue is over serve_admission_queue_limit")
                 pool = replicas
                 if model_id:
                     warm = [r for r in replicas if self._key(r) in warm_keys]
@@ -164,7 +208,9 @@ class Router:
                     cands = [pool[0]]
                 else:
                     cands = random.sample(pool, 2)
-                cands.sort(key=lambda r: self._ongoing.get(self._key(r), 0))
+                cands.sort(key=lambda r: (
+                    self._slots_exhausted(self._key(r)),
+                    self._ongoing.get(self._key(r), 0)))
                 best = cands[0]
                 key = self._key(best)
                 with self._lock:

@@ -37,6 +37,28 @@ def get_multiplexed_model_id() -> str:
     return _current_model_id.get()
 
 
+class _LoadGate:
+    """One load ATTEMPT: waiters park on ``event``; if the loader raised,
+    ``error`` carries the exception to every waiter of THIS attempt (a later
+    request starts a fresh attempt — transient failures stay retryable)."""
+
+    __slots__ = ("event", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+def _wait_slice() -> float:
+    """internal_wait_timeout_s, with its default as the fallback."""
+    try:
+        from ray_tpu.core.config import config
+
+        return config().internal_wait_timeout_s
+    except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+        return 60.0
+
+
 class _ModelMultiplexWrapper:
     """LRU of loaded models keyed by model id."""
 
@@ -65,23 +87,34 @@ class _ModelMultiplexWrapper:
                     return self._models[model_id]
                 gate = self._loading.get(model_id)
                 if gate is None:
-                    gate = threading.Event()
+                    gate = _LoadGate()
                     self._loading[model_id] = gate
                     break  # this thread loads
-            gate.wait(timeout=600)
-            # loader finished (or failed) — re-check the cache
+            # Timed slices (not one magic 600s park): a loader thread lost
+            # to a kill mid-load wakes the waiters at the internal cadence
+            # to re-check instead of stranding them.
+            gate.event.wait(timeout=_wait_slice())
+            if gate.event.is_set() and gate.error is not None:
+                # THIS attempt failed: every parked waiter gets the loader's
+                # exception instead of serially re-running a failing loader.
+                raise gate.error
+            # loaded (or still loading / loader died) — re-check the cache
         try:
             model = self._loader(instance, model_id)
-            with self._lock:
-                self._models[model_id] = model
-                self._models.move_to_end(model_id)
-                if len(self._models) > self._max:
-                    self._models.popitem(last=False)  # LRU eviction
-            return model
-        finally:
+        except BaseException as e:  # noqa: BLE001 — propagate to waiters
             with self._lock:
                 self._loading.pop(model_id, None)
-            gate.set()
+            gate.error = e
+            gate.event.set()
+            raise
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            if len(self._models) > self._max:
+                self._models.popitem(last=False)  # LRU eviction
+            self._loading.pop(model_id, None)
+        gate.event.set()
+        return model
 
 
 def multiplexed(max_num_models_per_replica: int = 3):

@@ -48,8 +48,32 @@ class ReplicaActor:
         return True
 
     def get_metrics(self) -> Dict[str, float]:
+        """``ongoing``/``total`` (the router drain probe's keys) merged with
+        the hosted callable's ``get_engine_stats`` (slot occupancy, queue
+        depth — KV-occupancy-aware routing), when it exposes one."""
         with self._lock:
-            return {"ongoing": float(self._ongoing), "total": float(self._total)}
+            metrics = {"ongoing": float(self._ongoing),
+                       "total": float(self._total)}
+        if not self._is_function and hasattr(self._callable,
+                                             "get_engine_stats"):
+            try:
+                stats = self._callable.get_engine_stats() or {}
+                for k, v in stats.items():
+                    metrics.setdefault(k, float(v))
+            except Exception:  # noqa: BLE001 — a sick engine must not
+                from ray_tpu.utils.logging import (get_logger,  # break the
+                                                   log_swallowed)  # probe
+
+                log_swallowed(get_logger("serve_replica"),
+                              "get_engine_stats")
+        return metrics
+
+    def get_state(self) -> Dict[str, Any]:
+        """Model ids + load metrics in ONE control-plane RPC — what the
+        controller's periodic poll distributes to routers as
+        ``replica_load``."""
+        return {"model_ids": self.multiplexed_model_ids(),
+                "metrics": self.get_metrics()}
 
     def multiplexed_model_ids(self) -> list:
         """Model ids loaded in this replica (multiplex.py registry)."""
