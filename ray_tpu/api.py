@@ -10,6 +10,9 @@ wrappers; ``python/ray/runtime_context.py``). Semantics match the reference:
 from __future__ import annotations
 
 import functools
+import threading
+import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.core import runtime as _runtime_mod
@@ -193,28 +196,121 @@ def get_runtime_context() -> RuntimeContext:
     return RuntimeContext()
 
 
-def timeline() -> List[dict]:
+def _chrome_entry(e: dict) -> Optional[dict]:
+    if e.get("state") not in ("FINISHED", "FAILED"):
+        return None
+    entry = {
+        "name": e["name"],
+        "cat": e.get("kind", "task"),
+        "ph": "X",
+        "ts": (e["time"] - e.get("duration", 0)) * 1e6,
+        "dur": e.get("duration", 0) * 1e6,
+        "pid": e.get("node_id", "node"),
+        "tid": e["task_id"][:8],
+    }
+    if e.get("trace_id"):
+        # span linkage (cross-process trace propagation)
+        entry["args"] = {
+            "trace_id": e["trace_id"],
+            "span_id": e.get("span_id") or e.get("task_id"),
+            "parent_span_id": e.get("parent_span_id"),
+            "failed": e.get("state") == "FAILED",
+        }
+    return entry
+
+
+def _flow_events(events: List[dict], entries: List[dict]) -> List[dict]:
+    """Chrome flow events (``ph:"s"``/``ph:"f"``) linking parent→child
+    spans across processes — the arrows in the trace viewer."""
+    by_span: Dict[str, dict] = {}
+    for e, entry in zip(events, entries):
+        sid = e.get("span_id") or e.get("task_id")
+        if sid:
+            by_span[sid] = entry
+    flows: List[dict] = []
+    for e, entry in zip(events, entries):
+        parent = e.get("parent_span_id")
+        if not parent or parent not in by_span:
+            continue
+        sid = e.get("span_id") or e.get("task_id")
+        src = by_span[parent]
+        flows.append({"name": "span", "cat": "trace", "ph": "s", "id": sid,
+                      "pid": src["pid"], "tid": src["tid"],
+                      "ts": src["ts"]})
+        flows.append({"name": "span", "cat": "trace", "ph": "f", "bp": "e",
+                      "id": sid, "pid": entry["pid"], "tid": entry["tid"],
+                      "ts": entry["ts"]})
+    return flows
+
+
+class _TimelineFeed:
+    """Per-caller rolling chrome-trace cache: each call pulls only the NEW
+    task events through cursor-paged ``task_events_since`` reads (the
+    dashboard ``/api/events`` pattern) instead of copying and reconverting
+    the whole up-to-100k-event log every time."""
+
+    PAGE = 5000
+    MAX_ENTRIES = 100_000
+
+    def __init__(self, gcs):
+        self.cursor = 0
+        self.entries: List[dict] = []
+        self.last_seen = time.monotonic()
+        # Identity of the GCS this cursor indexes into — a new runtime means
+        # a new event log, so a stale feed must restart from zero. A weakref
+        # (not id()) so a freed-and-reallocated store can't alias the old.
+        self.gcs_ref = weakref.ref(gcs)
+
+    def pull(self, gcs) -> None:
+        while True:
+            self.cursor, events = gcs.task_events_since(self.cursor,
+                                                        self.PAGE)
+            for e in events:
+                entry = _chrome_entry(e)
+                if entry is not None:
+                    self.entries.append(entry)
+            if len(events) < self.PAGE:
+                break
+        if len(self.entries) > self.MAX_ENTRIES:
+            del self.entries[:len(self.entries) // 2]
+
+
+_TL_FEEDS: Dict[str, _TimelineFeed] = {}
+_TL_LOCK = threading.Lock()
+_TL_CLIENT_CAP = 32
+_TL_CLIENT_TTL_S = 60.0
+
+
+def timeline(trace_id: Optional[str] = None,
+             client: str = "default") -> List[dict]:
     """Chrome-trace-style task events (reference:
-    ``python/ray/_private/state.py:434 chrome_tracing_dump``)."""
-    events = get_runtime().gcs.task_events()
-    trace = []
-    for e in events:
-        if e.get("state") in ("FINISHED", "FAILED"):
-            entry = {
-                "name": e["name"],
-                "cat": e.get("kind", "task"),
-                "ph": "X",
-                "ts": (e["time"] - e.get("duration", 0)) * 1e6,
-                "dur": e.get("duration", 0) * 1e6,
-                "pid": e.get("node_id", "node"),
-                "tid": e["task_id"][:8],
-            }
-            if e.get("trace_id"):
-                # span linkage (cross-process trace propagation)
-                entry["args"] = {
-                    "trace_id": e["trace_id"],
-                    "parent_span_id": e.get("parent_span_id"),
-                    "failed": e.get("state") == "FAILED",
-                }
-            trace.append(entry)
-    return trace
+    ``python/ray/_private/state.py:434 chrome_tracing_dump``).
+
+    With ``trace_id``, returns ONE trace's events (an indexed GCS lookup)
+    plus flow events linking parent→child spans across processes. Without,
+    returns the rolling full timeline; ``client`` names the caller's
+    incremental cursor cache."""
+    gcs = get_runtime().gcs
+    if trace_id is not None:
+        events = gcs.trace(trace_id)
+        entries = [_chrome_entry(e) for e in events]
+        keep = [(e, en) for e, en in zip(events, entries) if en is not None]
+        events, entries = [e for e, _ in keep], [en for _, en in keep]
+        return entries + _flow_events(events, entries)
+    now = time.monotonic()
+    with _TL_LOCK:
+        feed = _TL_FEEDS.get(client)
+        if feed is not None and feed.gcs_ref() is not gcs:
+            del _TL_FEEDS[client]
+            feed = None
+        if feed is None:
+            for key, f in list(_TL_FEEDS.items()):
+                if now - f.last_seen > _TL_CLIENT_TTL_S:
+                    del _TL_FEEDS[key]
+            while len(_TL_FEEDS) >= _TL_CLIENT_CAP:
+                oldest = min(_TL_FEEDS, key=lambda k: _TL_FEEDS[k].last_seen)
+                del _TL_FEEDS[oldest]
+            feed = _TL_FEEDS[client] = _TimelineFeed(gcs)
+        feed.last_seen = now
+        feed.pull(gcs)
+        return list(feed.entries)

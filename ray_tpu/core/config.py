@@ -248,6 +248,25 @@ class Config:
     # on the same ~10s cadence). Read every tick, so a cluster-adopted
     # config applies without an exporter restart.
     metrics_export_interval_s = _Flag(10.0)
+    # Request tracing master gate: spans from the serve data plane, compiled
+    # DAG ticks and traced RPCs. Off = every potential span costs one flag
+    # check (the metrics_export_enabled pattern); on, head-based sampling
+    # below decides per-trace at the ROOT.
+    trace_enabled = _Flag(True)
+    # Head-based sampling probability in [0, 1]: decided ONCE where a trace
+    # root is stamped (serve handle, user span, DAG tick) and carried in the
+    # context, so a trace is either fully collected or not at all — never a
+    # half-collected tree. 1.0 samples everything (test/dev default).
+    trace_sample_rate = _Flag(1.0)
+    # Also annotate blocking RpcClient.call()s reachable from a SAMPLED
+    # trace context with client-side rpc spans. Off by default — control
+    # planes make many calls per request and the span volume is rarely
+    # worth it outside latency investigations.
+    trace_rpc_enabled = _Flag(False)
+    # Bound on the GCS trace_id -> event-index side table (per-trace
+    # retrieval without scanning the 100k-event ring). Oldest traces are
+    # evicted first; events older than the ring's base are pruned lazily.
+    trace_max_traces = _Flag(2048)
 
     # -- debugging ------------------------------------------------------------
     # Opt-in runtime lock-order validator (ray_tpu.devtools.lockcheck):

@@ -139,8 +139,19 @@ class _GcsClientAdapter:
         except RpcConnectionError:
             pass
 
+    def record_task_events(self, events: List[dict]) -> None:
+        """Batched form — one coalescable notify per span/event flush."""
+        try:
+            self._client.notify("record_task_events", events)
+        except RpcConnectionError:
+            pass
+
     def task_events(self) -> List[dict]:
         return self._client.call("task_events")
+
+    def trace(self, trace_id: str) -> List[dict]:
+        """Assembled per-trace event list from the GCS trace index."""
+        return self._client.call("trace", trace_id)
 
     def task_events_since(self, cursor, limit: int = 1000):
         """Cursor'd task-event poll: (next_cursor, new_events)."""
@@ -3180,6 +3191,12 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        from ray_tpu.util import tracing
+
+        try:
+            tracing.flush(self)
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            log_swallowed(logger, "trace flush at shutdown")
         self._metrics_exporter.stop()
         # Abort the log-mirror's parked long-poll (closing the client
         # errors the in-flight call) and join the thread.

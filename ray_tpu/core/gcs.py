@@ -105,6 +105,13 @@ class GlobalControlStore:
         # Absolute index of _task_events[0] (events truncated off the front
         # advance it) — the cursor space of task_events_since.
         self._task_event_base = 0
+        # Bounded trace_id -> [absolute event index] side table: per-trace
+        # retrieval (trace()) assembles one trace without scanning the
+        # 100k-event ring. Insertion-ordered; oldest traces evict first
+        # when over trace_max_traces.
+        from collections import OrderedDict
+
+        self._trace_index: "OrderedDict[str, List[int]]" = OrderedDict()
         # Cluster metrics plane: per-(node, component, pid) series store fed
         # by every process's exporter (metrics_agent → gcs analog).
         from ray_tpu.util.metrics import MetricsAggregator
@@ -236,11 +243,57 @@ class GlobalControlStore:
 
     def record_task_event(self, event: dict) -> None:
         with self._lock:
-            self._task_events.append(event)
-            if len(self._task_events) > 100_000:
-                drop = len(self._task_events) // 2
-                del self._task_events[:drop]
-                self._task_event_base += drop
+            self._record_task_event_locked(event)
+
+    def record_task_events(self, events: List[dict]) -> None:
+        """Batched ingest — one call per worker flush (the
+        ``task_event_buffer.cc`` batch), one lock round for the batch."""
+        with self._lock:
+            for event in events:
+                self._record_task_event_locked(event)
+
+    def _record_task_event_locked(self, event: dict) -> None:
+        trace_id = event.get("trace_id")
+        if trace_id:
+            idxs = self._trace_index.get(trace_id)
+            if idxs is None:
+                self._trace_index[trace_id] = idxs = []
+                while len(self._trace_index) > self._trace_index_cap():
+                    self._trace_index.popitem(last=False)
+            idxs.append(self._task_event_base + len(self._task_events))
+        self._task_events.append(event)
+        if len(self._task_events) > 100_000:
+            drop = len(self._task_events) // 2
+            del self._task_events[:drop]
+            self._task_event_base += drop
+            # Indices below the new base point at truncated events; prune
+            # them (and now-empty traces) so trace() never dereferences one.
+            for tid in list(self._trace_index):
+                kept = [i for i in self._trace_index[tid]
+                        if i >= self._task_event_base]
+                if kept:
+                    self._trace_index[tid] = kept
+                else:
+                    del self._trace_index[tid]
+
+    @staticmethod
+    def _trace_index_cap() -> int:
+        from ray_tpu.core.config import config
+
+        try:
+            return max(1, int(config().trace_max_traces))
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            return 2048
+
+    def trace(self, trace_id: str) -> List[dict]:
+        """All retained events of one trace, oldest first — an indexed
+        lookup, not a scan of the event ring."""
+        with self._lock:
+            idxs = self._trace_index.get(trace_id)
+            if not idxs:
+                return []
+            base = self._task_event_base
+            return [self._task_events[i - base] for i in idxs if i >= base]
 
     def task_events(self) -> List[dict]:
         with self._lock:

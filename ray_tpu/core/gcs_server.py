@@ -941,8 +941,11 @@ class GcsService:
     def record_task_events(self, events: List[dict]) -> None:
         """Batched form — workers flush their task-event buffers here
         (task_event_buffer.cc → gcs_task_manager.cc)."""
-        for event in events:
-            self.store.record_task_event(event)
+        self.store.record_task_events(events)
+
+    def trace(self, trace_id: str) -> List[dict]:
+        """Assembled per-trace event list (indexed lookup, no ring scan)."""
+        return self.store.trace(trace_id)
 
     def task_events(self) -> List[dict]:
         return self.store.task_events()
@@ -1215,6 +1218,14 @@ def main(argv=None) -> int:
     from ray_tpu.devtools.leakcheck import maybe_install as _leak_install
 
     _leak_install()  # leak_check_enabled: stamp allocation sites early
+    # SIGUSR1 → all-thread stack dump, same live-hang debug aid the worker
+    # and node-daemon entry points install.
+    import faulthandler
+
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+    except (AttributeError, ValueError):  # non-main thread / platform
+        pass
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")

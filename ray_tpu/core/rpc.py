@@ -1049,6 +1049,7 @@ class RpcClient:
         self._sent_templates.add(digest)
 
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        trace_start = self._trace_call_start()
         fut = self.call_async(method, *args, **kwargs)
         self.flush()
         try:
@@ -1057,6 +1058,35 @@ class RpcClient:
             # Re-raise the original exception type when it round-tripped, so
             # callers catch domain errors (ValueError, TaskError...) natively.
             raise e.cause from e
+        finally:
+            if trace_start is not None:
+                self._trace_call_end(method, trace_start)
+
+    def _trace_call_start(self):
+        """Opt-in (``trace_rpc_enabled``) client-side rpc spans, only for
+        calls reachable from a SAMPLED trace context — which inherently
+        keeps the span-export path itself (flusher threads carry no
+        context) out of the trace. Off: one flag check."""
+        from ray_tpu.util import tracing
+
+        if not tracing.is_sampled():
+            return None
+        try:
+            from ray_tpu.core.config import config
+
+            if not config().trace_rpc_enabled:
+                return None
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            return None
+        return (tracing.current_context(), time.monotonic())
+
+    def _trace_call_end(self, method: str, trace_start) -> None:
+        from ray_tpu.util import tracing
+
+        ctx, t0 = trace_start
+        tracing.emit(f"rpc.{method}", ctx,
+                     duration=time.monotonic() - t0,
+                     attrs={"addr": self.address})
 
     def release_dests(self, futs, wait_timeout: float = 30.0) -> None:
         """Revoke the registered reply destinations of abandoned calls.

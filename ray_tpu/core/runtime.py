@@ -772,6 +772,7 @@ class Runtime:
         self._ctx.held_resources = held
         self._ctx.held_node = node.node_id
         started = time.time()
+        trace_id, span_id, parent_span = self._adopt_trace(spec)
         # Lifecycle phase stamps (same split as the multiprocess worker's
         # execute loop): submit→dispatch, dep fetch, user-code runtime.
         phases = ({"queued": max(0.0, started - spec.submit_ts)}
@@ -798,6 +799,8 @@ class Runtime:
             self.gcs.record_task_event(
                 {"task_id": spec.task_id.hex(), "name": spec.function_name, "state": "FINISHED",
                  "time": time.time(), "duration": time.time() - started, "node_id": node.node_id.hex(),
+                 "trace_id": trace_id, "span_id": span_id,
+                 "parent_span_id": parent_span,
                  "phases": {k: round(v, 6) for k, v in phases.items()}}
             )
         except _DependencyFailed as df:
@@ -809,6 +812,9 @@ class Runtime:
             failure = e
             observe_task_phases(phases, ok=False)
         finally:
+            from ray_tpu.util import tracing
+
+            tracing.set_context(None)
             self._ctx.in_worker = False
             self._ctx.task_state = None
             self._ctx.task_id = None
@@ -1150,6 +1156,45 @@ class Runtime:
             self._seq_expected[key] = expected
             return admitted
 
+    def _adopt_trace(self, spec: TaskSpec) -> tuple:
+        """Execute this task under the submitter's span context (the
+        in-process half of worker_main._begin_trace): the task becomes a
+        span of the caller's trace, and spans opened inside it — serve
+        replica/engine instrumentation runs HERE in-process — inherit the
+        root's sampling decision."""
+        from ray_tpu.util import tracing
+
+        span_id = spec.task_id.hex()[:16]
+        trace_id = spec.trace_ctx[0] if spec.trace_ctx else span_id
+        parent = spec.trace_ctx[1] if spec.trace_ctx else None
+        sampled = (bool(spec.trace_ctx[2])
+                   if spec.trace_ctx and len(spec.trace_ctx) > 2 else True)
+        tracing.set_context((trace_id, span_id, sampled))
+        return trace_id, span_id, parent
+
+    def _record_actor_task_event(self, runner: ActorRunner, spec: TaskSpec,
+                                 trace: tuple, started: float,
+                                 ok: bool) -> None:
+        """Actor tasks emit a trace-linked task event only when the spec
+        carries a SAMPLED trace context — the plain actor-call hot path
+        (untraced) stays event-free as before."""
+        if not (spec.trace_ctx and len(spec.trace_ctx) > 2
+                and spec.trace_ctx[2]):
+            return
+        trace_id, span_id, parent = trace
+        now = time.time()
+        self.gcs.record_task_event({
+            "task_id": spec.task_id.hex(),
+            "name": f"{spec.function_name}.{spec.actor_method}",
+            "state": "FINISHED" if ok else "FAILED",
+            "time": now,
+            "duration": now - started,
+            "node_id": runner.node_id.hex() if runner.node_id else "",
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent,
+        })
+
     def _execute_actor_task(self, runner: ActorRunner, state: TaskState) -> None:
         spec = state.spec
         self._ctx.task_id = spec.task_id
@@ -1157,6 +1202,7 @@ class Runtime:
         self._ctx.node_id = runner.node_id
         self._ctx.in_worker = True
         started = time.time()
+        trace = self._adopt_trace(spec)
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
@@ -1171,6 +1217,7 @@ class Runtime:
                 phases["queued"] = max(0.0, started - spec.submit_ts)
                 phases["total"] = max(0.0, time.time() - spec.submit_ts)
             observe_task_phases(phases)
+            self._record_actor_task_event(runner, spec, trace, started, True)
         except _DependencyFailed as df:
             self._store_error(state, df.error)
             observe_task_phases({"queued": max(0.0, started - spec.submit_ts)}
@@ -1182,7 +1229,11 @@ class Runtime:
             self._store_error(state, TaskError.from_exception(f"{spec.function_name}.{spec.actor_method}", e))
             observe_task_phases({"queued": max(0.0, started - spec.submit_ts)}
                                 if spec.submit_ts else {}, ok=False)
+            self._record_actor_task_event(runner, spec, trace, started, False)
         finally:
+            from ray_tpu.util import tracing
+
+            tracing.set_context(None)
             self._ctx.in_worker = False
             self._ctx.task_id = None
             self._ctx.actor_id = None
@@ -1196,6 +1247,11 @@ class Runtime:
 
     async def _execute_actor_task_async(self, runner: ActorRunner, state: TaskState) -> None:
         spec = state.spec
+        started = time.time()
+        # Each asyncio task owns a private contextvars copy, so adopting the
+        # caller's span context here can't cross-contaminate interleaved
+        # methods — and needs no reset.
+        trace = self._adopt_trace(spec)
         try:
             if state.cancelled:
                 raise TaskCancelledError(spec.task_id)
@@ -1212,12 +1268,14 @@ class Runtime:
             if inspect.iscoroutine(result):
                 result = await result
             self._store_results(state, result)
+            self._record_actor_task_event(runner, spec, trace, started, True)
         except _DependencyFailed as df:
             self._store_error(state, df.error)
         except TaskCancelledError:
             self._finish_cancelled(state)
         except BaseException as e:  # noqa: BLE001
             self._store_error(state, TaskError.from_exception(f"{spec.function_name}.{spec.actor_method}", e))
+            self._record_actor_task_event(runner, spec, trace, started, False)
         finally:
             self._finalize_actor_task(state)
 
@@ -1284,6 +1342,9 @@ class Runtime:
         return self._ctx.node_id or self.head_node_id
 
     def shutdown(self) -> None:
+        from ray_tpu.util import tracing
+
+        tracing.flush(self)
         self._metrics_exporter.stop()
         from ray_tpu.util.state import _reset_task_cache
 

@@ -150,6 +150,12 @@ class WorkerService:
         self._spec_store = SpecTemplateStore()
         self._task_lease = threading.local()
         self._events = _TaskEventBuffer(core._gcs_rpc)
+        # Spans opened in this worker ride the SAME batched task-event
+        # pipeline (one record_task_events notify per flush) instead of
+        # paying one RPC per span.
+        from ray_tpu.util import tracing
+
+        tracing.set_sink(self._events.record)
         # Blocked-worker protocol (reference: CPU released while a worker
         # blocks in ray.get — worker.py release/reacquire; prevents nested
         # task deadlock on a fully leased cluster).
@@ -215,7 +221,11 @@ class WorkerService:
         span_id = spec.task_id.hex()[:16]
         trace_id = spec.trace_ctx[0] if spec.trace_ctx else span_id
         parent = spec.trace_ctx[1] if spec.trace_ctx else None
-        tracing.set_context((trace_id, span_id))
+        # Carry the root's head-based sampling decision so spans opened
+        # inside this task inherit it (never a half-collected trace).
+        sampled = (bool(spec.trace_ctx[2])
+                   if spec.trace_ctx and len(spec.trace_ctx) > 2 else True)
+        tracing.set_context((trace_id, span_id, sampled))
         return (trace_id, span_id, parent, time.time())
 
     def _end_trace(self, spec: TaskSpec, trace: tuple, ok: bool,

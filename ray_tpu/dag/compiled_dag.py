@@ -43,6 +43,23 @@ class _DagError:
         self.message = message
 
 
+class _TracedPayload:
+    """A tick payload carrying its trace context across channel edges.
+
+    Wrapped only when the driver's ``execute`` ran under a SAMPLED trace
+    context — the untraced µs-path ships raw payloads and pays one
+    ``type`` check per edge read. Stages unwrap, time the method as a
+    ``dag.stage`` child span of the tick span, and re-wrap so downstream
+    stages (and the driver) stay in the trace."""
+
+    __slots__ = ("ctx", "tick_span", "value")
+
+    def __init__(self, ctx, tick_span, value):
+        self.ctx = ctx
+        self.tick_span = tick_span
+        self.value = value
+
+
 def actor_dag_loop(instance, method_name: str, in_channels: List[Any],
                    out_channels: List[Any],
                    arg_template: Optional[List[Tuple[str, Any]]] = None
@@ -79,16 +96,33 @@ def actor_dag_loop(instance, method_name: str, in_channels: List[Any],
                 for och in out_channels:
                     och.close()
                 return "closed"
+            trace = None
+            if any(type(v) is _TracedPayload for v in values):
+                trace = next(v for v in values
+                             if type(v) is _TracedPayload)
+                values = [v.value if type(v) is _TracedPayload else v
+                          for v in values]
             err = next((v for v in values if isinstance(v, _DagError)), None)
             if err is not None:
                 result = err
             else:
                 args = [values[payload] if kind == "c" else payload
                         for kind, payload in arg_template]
+                t0 = time.monotonic()
                 try:
                     result = method(*args)
                 except Exception as exc:  # noqa: BLE001 — deliver to caller
                     result = _DagError(f"{type(exc).__name__}: {exc}")
+                if trace is not None:
+                    from ray_tpu.util import tracing
+
+                    tracing.emit(
+                        f"dag.stage:{method_name}", trace.ctx,
+                        duration=time.monotonic() - t0,
+                        parent_span_id=trace.tick_span,
+                        attrs={"method": method_name})
+            if trace is not None:
+                result = _TracedPayload(trace.ctx, trace.tick_span, result)
             try:
                 for och in out_channels:
                     # Bounded: a consumer that stopped draining (died mid-
@@ -265,6 +299,9 @@ class CompiledDAG:
         # tick alignment across output channels survives the retry.
         self._partial_outs: List[Any] = []
         self._tick_start: Dict[int, float] = {}
+        # index -> (trace_ctx, tick_span_id) for ticks executed under a
+        # sampled trace; the dag.tick span closes at _fetch.
+        self._tick_trace: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self._write_lock = threading.Lock()
         self._torn_down = False
@@ -312,7 +349,17 @@ class CompiledDAG:
             raise RuntimeError("DAG was torn down")
         from ray_tpu.core import serialization
         from ray_tpu.core.metrics_export import metrics_enabled
+        from ray_tpu.util import tracing
 
+        # Tick tracing: only when execute() runs under an already-SAMPLED
+        # context (a serve request, a user span) — the untraced µs path
+        # pays one flag check and ships the raw payload.
+        trace_ctx = tick_span = None
+        if tracing.trace_enabled():
+            ctx = tracing.current_context()
+            if ctx is not None and ctx[2]:
+                trace_ctx, tick_span = ctx, tracing.new_span_id()
+                value = _TracedPayload(trace_ctx, tick_span, value)
         rings = [ch for ch in self._input_channels if isinstance(ch, Channel)]
         others = [ch for ch in self._input_channels
                   if not isinstance(ch, Channel)]
@@ -343,8 +390,10 @@ class CompiledDAG:
                 ch.write(value, timeout=timeout)
             index = self._next_index
             self._next_index += 1
-            if metrics_enabled():
+            if metrics_enabled() or trace_ctx is not None:
                 self._tick_start[index] = time.monotonic()
+            if trace_ctx is not None:
+                self._tick_trace[index] = (trace_ctx, tick_span)
         return DAGRef(self, index)
 
     def _fetch(self, index: int, timeout: Optional[float]):
@@ -361,10 +410,13 @@ class CompiledDAG:
                     ch = self._output_channels[len(self._partial_outs)]
                     self._partial_outs.append(ch.read(timeout=timeout))
                 outs, self._partial_outs = self._partial_outs, []
+                outs = [o.value if type(o) is _TracedPayload else o
+                        for o in outs]
                 self._fetched[self._reads] = (tuple(outs) if self._multi_output
                                               else outs[0])
                 self._reads += 1
             result = self._fetched.pop(index)
+            trace = self._tick_trace.pop(index, None)
         start = self._tick_start.pop(index, None)
         if start is not None:
             from ray_tpu.core.metrics_export import (dag_tick_hist,
@@ -372,6 +424,12 @@ class CompiledDAG:
 
             if metrics_enabled():
                 dag_tick_hist().observe(time.monotonic() - start)
+            if trace is not None:
+                from ray_tpu.util import tracing
+
+                tracing.emit("dag.tick", trace[0], span_id=trace[1],
+                             duration=time.monotonic() - start,
+                             attrs={"index": index})
         return result
 
     def teardown(self) -> None:

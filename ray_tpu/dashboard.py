@@ -94,6 +94,7 @@ class Dashboard:
                            self._json(_metrics_summary))
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/timeline", self._timeline)
+        app.router.add_get("/api/trace/{trace_id}", self._trace)
 
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -165,13 +166,36 @@ class Dashboard:
         return web.Response(text=text, content_type="text/plain")
 
     async def _timeline(self, request):
+        """Chrome-trace dump. ``?trace_id=`` narrows to one trace (indexed
+        GCS lookup + flow events); ``?client=`` names the caller's
+        incremental cursor cache for the full-timeline path."""
         from aiohttp import web
 
         import ray_tpu
 
+        trace_id = request.query.get("trace_id")
+        client = request.query.get("client", "dashboard")
         loop = asyncio.get_event_loop()
-        trace = await loop.run_in_executor(None, ray_tpu.timeline)
+        trace = await loop.run_in_executor(
+            None, lambda: ray_tpu.timeline(trace_id=trace_id, client=client))
         return web.Response(text=json.dumps(trace), content_type="application/json")
+
+    async def _trace(self, request):
+        """One assembled trace's raw span/task events, oldest first — the
+        ``gcs.trace(trace_id)`` side-table lookup over HTTP."""
+        from aiohttp import web
+
+        trace_id = request.match_info["trace_id"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.core.runtime import get_runtime
+
+            return get_runtime().gcs.trace(trace_id)
+
+        events = await loop.run_in_executor(None, fetch)
+        return web.Response(text=json.dumps(events, default=str),
+                            content_type="application/json")
 
     async def _index(self, request):
         from aiohttp import web

@@ -59,11 +59,79 @@ def cmd_timeline(args) -> int:
     import ray_tpu
 
     _init_from_args(args)
-    trace = ray_tpu.timeline()
+    trace = ray_tpu.timeline(trace_id=args.trace_id)
     with open(args.output, "w") as f:
         json.dump(trace, f)
     print(f"wrote {len(trace)} events to {args.output}")
     return 0
+
+
+def _span_key(e: dict) -> str:
+    # Spans carry their id in task_id; task events in span_id.
+    return e.get("span_id") or str(e.get("task_id", ""))
+
+
+def format_trace_tree(events) -> str:
+    """Render one trace's events as an indented span tree with durations,
+    plus the TTFT decomposition when the trace covers an LLM request."""
+    if not events:
+        return "(no events — unknown trace id, or the trace was unsampled)"
+    by_id = {_span_key(e): e for e in events}
+    children: dict = {}
+    roots = []
+    for e in events:
+        parent = e.get("parent_span_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(e)
+        else:
+            roots.append(e)
+    start = lambda e: e.get("time", 0) - e.get("duration", 0)  # noqa: E731
+    lines = [f"trace {events[0].get('trace_id', '?')}"]
+
+    def walk(e, depth):
+        dur = e.get("duration", 0)
+        attrs = e.get("attrs") or {}
+        extra = ("  " + " ".join(f"{k}={v}" for k, v in attrs.items())
+                 if attrs else "")
+        fail = "  FAILED" if e.get("state") == "FAILED" else ""
+        lines.append(f"{'  ' * depth}{e.get('name', '?')}  "
+                     f"{dur * 1e3:.2f}ms{fail}{extra}")
+        for c in sorted(children.get(_span_key(e), []), key=start):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=start):
+        walk(r, 1)
+
+    # TTFT decomposition: admission wait + prefill + first decode chunk.
+    parts = []
+    for name in ("llm.admission_wait", "llm.prefill"):
+        found = [e for e in events if e.get("name") == name]
+        if found:
+            parts.append((name, min(found, key=start)["duration"]))
+    decodes = [e for e in events if e.get("name") == "llm.decode_chunk"]
+    if decodes:
+        parts.append(("llm.decode_chunk[0]",
+                      min(decodes, key=start)["duration"]))
+    if parts:
+        lines.append("")
+        lines.append("TTFT breakdown:")
+        for name, dur in parts:
+            lines.append(f"  {name:<22}{dur * 1e3:.2f}ms")
+        lines.append(f"  {'= TTFT':<22}"
+                     f"{sum(d for _, d in parts) * 1e3:.2f}ms")
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    from ray_tpu.core.runtime import get_runtime
+
+    _init_from_args(args)
+    events = get_runtime().gcs.trace(args.trace_id)
+    if args.json:
+        print(json.dumps(events, indent=2, default=str))
+    else:
+        print(format_trace_tree(events))
+    return 0 if events else 1
 
 
 def cmd_bench(args) -> int:
@@ -94,6 +162,13 @@ def main(argv=None) -> int:
 
     p_tl = sub.add_parser("timeline", help="dump chrome trace")
     p_tl.add_argument("-o", "--output", default="timeline.json")
+    p_tl.add_argument("--trace-id", default=None,
+                      help="dump only this trace (with flow events)")
+
+    p_tr = sub.add_parser("trace", help="print one trace as a span tree")
+    p_tr.add_argument("trace_id")
+    p_tr.add_argument("--json", action="store_true",
+                      help="raw events instead of the tree")
 
     sub.add_parser("bench", help="run the headline benchmark")
 
@@ -103,6 +178,7 @@ def main(argv=None) -> int:
         "list": cmd_list,
         "summary": cmd_summary,
         "timeline": cmd_timeline,
+        "trace": cmd_trace,
         "bench": cmd_bench,
     }[args.cmd](args)
 

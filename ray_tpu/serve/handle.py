@@ -31,12 +31,22 @@ class DeploymentResponse:
     MAX_REPLICA_RETRIES = 3
 
     def __init__(self, ref, router: "Router", replica_key: str,
-                 resubmit=None):
+                 resubmit=None, trace=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
         self._resubmit = resubmit
         self._done = False
+        # (parent_ctx, req_ctx, submit_wall_time) from the handle — the
+        # serve.request root span closes when the response finishes.
+        self._trace = trace
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """Trace id of this request, or None when tracing didn't sample."""
+        if self._trace and self._trace[1] is not None and self._trace[1][2]:
+            return self._trace[1][0]
+        return None
 
     def result(self, timeout_s: Optional[float] = None):
         from ray_tpu.core.exceptions import ActorError
@@ -65,18 +75,46 @@ class DeploymentResponse:
         if not self._done:
             self._done = True
             self._router._dec(self._replica_key)
+            _emit_request_span(self._trace, self._replica_key)
 
     @property
     def ref(self):
         return self._ref
 
 
+def _emit_request_span(trace, replica_key: str) -> None:
+    """Close the serve.request root span (submission → response finished)."""
+    if trace is None:
+        return
+    from ray_tpu.util import tracing
+
+    parent_ctx, req_ctx, submit_t = trace
+    if req_ctx is None or not req_ctx[2]:
+        return
+    # The span's own id was pre-allocated as req_ctx's span (children
+    # already parented to it); its parent is the caller's span, if any.
+    tracing.emit(
+        "serve.request",
+        (req_ctx[0], parent_ctx[1] if parent_ctx else None, req_ctx[2]),
+        span_id=req_ctx[1],
+        duration=max(0.0, time.time() - submit_t),
+        attrs={"replica": replica_key})
+
+
 class DeploymentResponseGenerator:
-    def __init__(self, gen, router: "Router", replica_key: str):
+    def __init__(self, gen, router: "Router", replica_key: str, trace=None):
         self._gen = gen
         self._router = router
         self._replica_key = replica_key
         self._done = False
+        self._trace = trace
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """Trace id of this request, or None when tracing didn't sample."""
+        if self._trace and self._trace[1] is not None and self._trace[1][2]:
+            return self._trace[1][0]
+        return None
 
     def __iter__(self):
         try:
@@ -86,6 +124,7 @@ class DeploymentResponseGenerator:
             if not self._done:
                 self._done = True
                 self._router._dec(self._replica_key)
+                _emit_request_span(self._trace, self._replica_key)
 
 
 class Router:
@@ -254,23 +293,75 @@ class DeploymentHandle:
         h._metrics_thread = self._metrics_thread
         return h
 
+    def _trace_root(self):
+        """Stamp this request's trace frame: ``(parent_ctx, req_ctx)``.
+
+        ``req_ctx`` carries the ``serve.request`` span id — installed as the
+        ambient context around pick+submit so the router-pick span and the
+        replica task parent to it — and the head-based sampling decision,
+        made HERE when the handle call is the trace root (inherited when the
+        caller already opened a span). (None, None) when tracing is off."""
+        from ray_tpu.util import tracing
+
+        parent = tracing.current_context()
+        root = parent if parent is not None else tracing.new_root_context()
+        if root is None:
+            return None, None
+        return parent, tracing.child_context(root, tracing.new_span_id())
+
+    def _emit_pick_span(self, req_ctx, key: str, elapsed_s: float) -> None:
+        """Router-pick span: the chosen replica plus the occupancy snapshot
+        the choice was made on (ongoing count, reported KV-slot load)."""
+        from ray_tpu.util import tracing
+
+        attrs = {"replica": key, "deployment": self._name}
+        router = self._router
+        with router._lock:
+            attrs["ongoing"] = router._ongoing.get(key, 0)
+            load = router._replica_load.get(key)
+        if load:
+            for stat in ("slots_busy", "slots_total", "queue_depth"):
+                if stat in load:
+                    attrs[stat] = load[stat]
+        tracing.emit("serve.router_pick", req_ctx, duration=elapsed_s,
+                     attrs=attrs)
+
     def remote(self, *args, **kwargs):
+        from ray_tpu.util import tracing
+
         model_id = getattr(self, "_model_id", "")
-        replica, key = self._router._pick(model_id)
-        if model_id:
-            kwargs["_multiplexed_model_id"] = model_id
-        if self._stream:
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming"
-            ).remote(self._method, *args, **kwargs)
-            return DeploymentResponseGenerator(gen, self._router, key)
-        ref = replica.handle_request.remote(self._method, *args, **kwargs)
+        parent_ctx, req_ctx = self._trace_root()
+        sampled = req_ctx is not None and req_ctx[2]
+        submit_t = time.time()
+        t0 = time.monotonic()
+        try:
+            if req_ctx is not None:
+                tracing.set_context(req_ctx)
+            replica, key = self._router._pick(model_id)
+            if sampled:
+                self._emit_pick_span(req_ctx, key, time.monotonic() - t0)
+                kwargs["_trace_submit_ts"] = time.time()
+            if model_id:
+                kwargs["_multiplexed_model_id"] = model_id
+            if self._stream:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(self._method, *args, **kwargs)
+                return DeploymentResponseGenerator(
+                    gen, self._router, key,
+                    trace=(parent_ctx, req_ctx, submit_t))
+            ref = replica.handle_request.remote(self._method, *args, **kwargs)
 
-        def resubmit(method=self._method, a=args, kw=kwargs, mid=model_id):
-            rep, k = self._router._pick(mid)
-            return rep.handle_request.remote(method, *a, **kw), k
+            def resubmit(method=self._method, a=args, kw=kwargs, mid=model_id):
+                rep, k = self._router._pick(mid)
+                return rep.handle_request.remote(method, *a, **kw), k
 
-        return DeploymentResponse(ref, self._router, key, resubmit=resubmit)
+            return DeploymentResponse(ref, self._router, key,
+                                      resubmit=resubmit,
+                                      trace=(parent_ctx, req_ctx, submit_t))
+        finally:
+            if req_ctx is not None:
+                tracing.set_context(parent_ctx)
 
     def _push_metrics(self):
         """Reference: ``replica.py:214 _push_autoscaling_metrics`` (pushed

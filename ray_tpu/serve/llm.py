@@ -42,6 +42,7 @@ import numpy as np
 from ray_tpu.models.generate import SlottedGenerator
 from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.serve.errors import Saturated
+from ray_tpu.util import tracing
 
 
 def _default_buckets(max_len: int) -> List[int]:
@@ -66,7 +67,7 @@ class _Request:
         "prompt", "padded", "real_len", "bucket", "max_new", "temperature",
         "seed", "tokens", "cond", "slot", "emitted", "done", "cancelled",
         "error", "finish_reason", "decode_tokens", "decode_seconds",
-        "submitted_at", "ttft_s",
+        "submitted_at", "ttft_s", "trace_ctx",
     )
 
     def __init__(self, prompt, padded, real_len, bucket, max_new,
@@ -90,6 +91,12 @@ class _Request:
         self.decode_seconds = 0.0
         self.submitted_at = time.perf_counter()
         self.ttft_s: Optional[float] = None
+        # Captured at submit time on the request's own thread; engine spans
+        # must use THIS explicit context (the step loop runs on whichever
+        # thread won the driver election — its ambient context belongs to a
+        # different request). None unless the trace sampled in.
+        self.trace_ctx = (tracing.current_context()
+                          if tracing.is_sampled() else None)
 
     def decode_tps(self) -> float:
         if self.decode_seconds == 0:
@@ -204,6 +211,8 @@ class LLMEngine:
             finally:
                 result["finish_reason"] = self.finish_reason = (
                     req.finish_reason or "stop")
+                if req.ttft_s is not None:
+                    result["ttft_s"] = req.ttft_s
 
         gen = run()
         # The request is submitted EAGERLY (Saturated raises at call time),
@@ -387,10 +396,22 @@ class LLMEngine:
                 self._active[free] = True
                 self._greedy[free] = nxt.temperature <= 0
                 self._temps[free] = nxt.temperature if nxt.temperature > 0 else 0.0
+            t_admit = time.perf_counter()
+            if nxt.trace_ctx is not None:
+                tracing.emit(
+                    "llm.admission_wait", nxt.trace_ctx,
+                    duration=t_admit - nxt.submitted_at,
+                    attrs={"slot": free, "engine": self.name})
             pf = self._sg.prefill_fn(nxt.bucket)
             self._cache, self._last, self._keys = pf(
                 self.params, self._cache, self._last, self._keys,
                 nxt.padded, nxt.real_len, free, nxt.seed)
+            if nxt.trace_ctx is not None:
+                tracing.emit(
+                    "llm.prefill", nxt.trace_ctx,
+                    duration=time.perf_counter() - t_admit,
+                    attrs={"slot": free, "bucket": nxt.bucket,
+                           "prompt_len": nxt.real_len})
             admitted_tokens += nxt.bucket
 
         with self._state_lock:
@@ -413,6 +434,8 @@ class LLMEngine:
         # 4. Distribute each slot's tokens to its request.
         delivered_total = 0
         ttfts: List[float] = []
+        batch_size = int(active.sum())
+        chunk_spans: List[tuple] = []  # sampled requests' (ctx, slot, ntok)
         with self._state_lock:
             for slot in range(self.slots):
                 req = self._slot_req[slot]
@@ -426,6 +449,8 @@ class LLMEngine:
                 if upto > 0 and req.ttft_s is None:
                     req.ttft_s = now - req.submitted_at
                     ttfts.append(req.ttft_s)
+                if req.trace_ctx is not None and upto > 0:
+                    chunk_spans.append((req.trace_ctx, slot, upto))
                 req.tokens.extend(int(t) for t in host_toks[slot][:upto])
                 req.emitted += upto
                 req.decode_tokens += upto
@@ -438,6 +463,11 @@ class LLMEngine:
         with self._agg_lock:
             self.decode_tokens += delivered_total
             self.decode_seconds += dt
+        # Emitted OUTSIDE _state_lock: span export may take its own locks.
+        for ctx, slot, ntok in chunk_spans:
+            tracing.emit("llm.decode_chunk", ctx, duration=dt, end_time=None,
+                         attrs={"slot": slot, "tokens": ntok,
+                                "batch": batch_size})
         self._observe(delivered_total, ttfts)
 
     def _observe(self, delivered: int, ttfts: List[float]) -> None:
@@ -602,6 +632,11 @@ def llm_deployment(
                         "decode_tps": round(outcome.get("decode_tps", 0.0), 1)}
             if prev is not None:
                 prev["finish_reason"] = outcome.get("finish_reason", "stop")
+                if "ttft_s" in outcome:
+                    # Measured submit→first-token latency — lets clients (and
+                    # the tracing tests) check the span decomposition against
+                    # the engine's own clock.
+                    prev["ttft_s"] = outcome["ttft_s"]
                 yield prev
 
         def get_engine_stats(self):

@@ -82,9 +82,26 @@ class ReplicaActor:
         return loaded_model_ids()
 
     # -- data plane ----------------------------------------------------------
+
+    def _trace_queue_wait(self, kwargs) -> None:
+        """Emit the handle-submit → replica-pickup span. The handle injects
+        ``_trace_submit_ts`` only into SAMPLED requests, so untraced calls
+        pay one dict-pop here and nothing else."""
+        submit_ts = kwargs.pop("_trace_submit_ts", None)
+        if submit_ts is None:
+            return
+        from ray_tpu.util import tracing
+
+        ctx = tracing.current_context()
+        if ctx is not None:
+            tracing.emit("serve.replica_queue", ctx,
+                         duration=max(0.0, time.time() - submit_ts),
+                         attrs={"deployment": self.deployment_name})
+
     def handle_request(self, method_name: str, *args, **kwargs):
         from ray_tpu.serve import multiplex
 
+        self._trace_queue_wait(kwargs)
         model_id = kwargs.pop("_multiplexed_model_id", "")
         token = multiplex.set_current_model_id(model_id)
         with self._lock:
@@ -146,6 +163,7 @@ class ReplicaActor:
         """Generator method: yields items (streamed via ObjectRefGenerator)."""
         from ray_tpu.serve import multiplex
 
+        self._trace_queue_wait(kwargs)
         model_id = kwargs.pop("_multiplexed_model_id", "")
         token = multiplex.set_current_model_id(model_id)
         with self._lock:

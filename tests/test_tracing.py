@@ -1,0 +1,242 @@
+"""End-to-end request tracing tests (ISSUE 10).
+
+A sampled serve LLM request must yield ONE connected trace — handle root,
+router pick, replica queue wait, engine admission/prefill/decode spans —
+retrievable by trace id through ``gcs.trace``, ``ray_tpu.timeline`` and the
+CLI tree, with the TTFT span decomposition matching the engine's measured
+TTFT. Head-based sampling is decided once at the root and inherited;
+export is batched (spans ≫ RPCs); compiled-DAG ticks trace only under an
+already-sampled caller.
+"""
+
+import jax
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.config import Config, set_config
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.dag import InputNode
+from ray_tpu.models import transformer
+from ray_tpu.serve.llm import llm_deployment
+from ray_tpu.util import tracing
+
+
+def _span_events(events, name=None):
+    spans = [e for e in events if e.get("kind") == "span"]
+    if name is not None:
+        spans = [e for e in spans if e.get("name") == name]
+    return spans
+
+
+def _ids(events):
+    return {e.get("span_id") or e["task_id"] for e in events}
+
+
+@pytest.fixture
+def fresh_config():
+    """Restore the default config after a test that overrides flags."""
+    yield set_config
+    set_config(Config())
+
+
+class TestServeTraceE2E:
+    def test_streamed_llm_request_yields_connected_trace(self,
+                                                         ray_start_regular):
+        """One streamed request through handle → router → replica → engine
+        produces a single connected tree, retrievable by trace id, whose
+        TTFT spans decompose the engine-measured TTFT."""
+        cfg = transformer.tiny(max_seq_len=64)
+        LM = llm_deployment(
+            cfg, lambda: transformer.init_params(cfg, jax.random.key(0)),
+            name="LM", slots=2, chunk=4)
+        try:
+            handle = serve.run(LM.bind())
+            with tracing.span("client") as (trace_id, _client_span):
+                gen = handle.options(stream=True).remote(
+                    {"prompt_ids": [7, 3, 11], "max_new_tokens": 8})
+                assert gen.trace_id == trace_id
+                items = list(gen)
+            assert items and items[-1]["finish_reason"] == "stop"
+            ttft = items[-1]["ttft_s"]
+            tracing.flush()
+
+            events = get_runtime().gcs.trace(trace_id)
+            names = {e["name"] for e in events}
+            for expected in ("client", "serve.request", "serve.router_pick",
+                            "serve.replica_queue", "llm.admission_wait",
+                            "llm.prefill", "llm.decode_chunk"):
+                assert expected in names, f"missing span {expected}: {names}"
+
+            # Connected: every event's parent resolves inside the trace
+            # (only the client root has no parent).
+            ids = _ids(events)
+            orphans = [e["name"] for e in events
+                       if e.get("parent_span_id")
+                       and e["parent_span_id"] not in ids]
+            assert not orphans, f"disconnected spans: {orphans}"
+            roots = [e for e in events if not e.get("parent_span_id")]
+            assert [e["name"] for e in roots] == ["client"]
+
+            # The router's pick recorded the occupancy snapshot it acted on.
+            pick = _span_events(events, "serve.router_pick")[0]
+            assert pick["attrs"]["deployment"] == "LM"
+            assert "replica" in pick["attrs"]
+
+            # TTFT decomposition: queue-side waits + prefill + first decode
+            # chunk account for the engine's measured TTFT.
+            first = lambda n: min(  # noqa: E731
+                _span_events(events, n), key=lambda e: e["time"])
+            parts = (first("llm.admission_wait")["duration"]
+                     + first("llm.prefill")["duration"]
+                     + first("llm.decode_chunk")["duration"])
+            assert ttft > 0
+            assert abs(parts - ttft) <= 0.10 * ttft + 0.015, \
+                f"TTFT decomposition {parts:.4f}s vs measured {ttft:.4f}s"
+        finally:
+            serve.shutdown()
+
+    def test_trace_reaches_timeline_and_cli_tree(self, ray_start_regular):
+        """The same trace is retrievable through the timeline view (with
+        flow events) and renders as the CLI span tree."""
+        with tracing.span("request") as (trace_id, _sid):
+            with tracing.span("inner"):
+                pass
+        tracing.flush()
+
+        view = ray_tpu.timeline(trace_id=trace_id)
+        assert {e["name"] for e in view if e["ph"] == "X"} == \
+            {"request", "inner"}
+        # Flow events pair up: one "s" (at the parent) and one "f" (at the
+        # child) per resolved parent link.
+        assert [e["ph"] for e in view if e["cat"] == "trace"] == ["s", "f"]
+
+        from ray_tpu.scripts import format_trace_tree
+
+        tree = format_trace_tree(get_runtime().gcs.trace(trace_id))
+        assert "request" in tree
+        assert "    inner" in tree  # nested under the root
+
+    def test_timeline_feed_is_incremental(self, ray_start_regular):
+        """Repeated timeline() polls reuse the per-caller cursor cache —
+        entries accumulate, they are not rebuilt from a full-log copy."""
+        with tracing.span("a"):
+            pass
+        tracing.flush()
+        first = ray_tpu.timeline(client="t-incr")
+        with tracing.span("b"):
+            pass
+        tracing.flush()
+        second = ray_tpu.timeline(client="t-incr")
+        assert len(second) == len(first) + 1
+        assert second[-1]["name"] == "b"
+
+
+class TestSampling:
+    def test_rate_zero_propagates_but_emits_nothing(self, ray_start_regular,
+                                                    fresh_config):
+        set_config(Config({"trace_sample_rate": 0.0}))
+        with tracing.span("root") as (trace_id, _sid):
+            assert not tracing.is_sampled()
+            with tracing.span("child"):
+                # The child inherits the root's NEGATIVE decision — same
+                # trace id, no fresh root, nothing emitted.
+                assert tracing.current_context()[0] == trace_id
+                assert not tracing.is_sampled()
+        tracing.flush()
+        assert _span_events(get_runtime().gcs.trace(trace_id)) == []
+
+    def test_rate_one_emits_connected_spans(self, ray_start_regular):
+        with tracing.span("root") as (trace_id, root_sid):
+            assert tracing.is_sampled()
+            with tracing.span("child"):
+                pass
+        tracing.flush()
+        events = get_runtime().gcs.trace(trace_id)
+        child = _span_events(events, "child")[0]
+        assert child["parent_span_id"] == root_sid
+
+    def test_unsampled_root_suppresses_actor_task_events(
+            self, ray_start_regular, fresh_config):
+        """Actor tasks submitted under an unsampled root emit no
+        trace-linked task events (the untraced hot path)."""
+
+        @ray_tpu.remote
+        class A:
+            def f(self):
+                return 1
+
+        a = A.remote()
+        set_config(Config({"trace_sample_rate": 0.0}))
+        with tracing.span("root") as (trace_id, _sid):
+            assert ray_tpu.get(a.f.remote()) == 1
+        tracing.flush()
+        assert get_runtime().gcs.trace(trace_id) == []
+
+    def test_gate_off_costs_no_context(self, ray_start_regular, fresh_config):
+        set_config(Config({"trace_enabled": False}))
+        assert tracing.new_root_context() is None
+        with tracing.span("root") as (trace_id, _sid):
+            assert not tracing.is_sampled()
+        tracing.flush()
+        assert get_runtime().gcs.trace(trace_id) == []
+
+
+class TestDagTracing:
+    def test_tick_spans_under_sampled_caller(self, ray_start_regular):
+        @ray_tpu.remote
+        class Doubler:
+            def apply(self, x):
+                return x * 2
+
+        d = Doubler.remote()
+        compiled = d.apply.bind(InputNode()).experimental_compile()
+        try:
+            # Untraced executes (no ambient context) emit nothing — the
+            # µs-scale tick path stays span-free.
+            assert compiled.execute(3).get(timeout=30) == 6
+            tracing.flush()
+            base = len(_span_events(
+                get_runtime().gcs.task_events(), "dag.tick"))
+
+            with tracing.span("driver") as (trace_id, _sid):
+                assert compiled.execute(5).get(timeout=30) == 10
+            tracing.flush()
+
+            events = get_runtime().gcs.trace(trace_id)
+            ticks = _span_events(events, "dag.tick")
+            stages = _span_events(events, "dag.stage:apply")
+            assert len(ticks) == 1 and len(stages) == 1
+            # Stage spans parent to their tick; the tick to the caller.
+            assert stages[0]["parent_span_id"] == ticks[0]["task_id"]
+            all_ticks = _span_events(
+                get_runtime().gcs.task_events(), "dag.tick")
+            assert len(all_ticks) == base + 1
+        finally:
+            compiled.teardown()
+
+
+class TestBatchedExport:
+    def test_spans_ship_in_batches_not_per_rpc(self, ray_start_regular,
+                                               monkeypatch):
+        gcs = get_runtime().gcs
+        calls = {"batches": 0, "events": 0}
+        real = gcs.record_task_events
+
+        def counting(events):
+            calls["batches"] += 1
+            calls["events"] += len(events)
+            return real(events)
+
+        monkeypatch.setattr(gcs, "record_task_events", counting)
+        tracing.flush()  # start from an empty buffer
+        n = 300
+        ctx = tracing.new_root_context()
+        assert ctx is not None and ctx[2]
+        for _ in range(n):
+            tracing.emit("bulk", ctx, duration=0.001)
+        tracing.flush()
+        assert calls["events"] >= n
+        # 300 spans ride ~ n/FLUSH_MAX batched record_task_events calls —
+        # far fewer RPCs than spans (time-triggered flushes add a handful).
+        assert calls["batches"] <= n // 32
