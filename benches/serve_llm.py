@@ -1,18 +1,30 @@
-"""Continuous-batching LLM serving benchmark (ISSUE 9 tentpole metric).
+"""Continuous-batching LLM serving benchmark (ISSUE 9 + ISSUE 11 metrics).
 
-A/B of the slotted continuous-batching ``LLMEngine`` against the same engine
-pinned to one slot (the batch-1 replica baseline it replaced): aggregate
-tokens/s and client-observed p50/p99 TTFT at concurrency 1/4/16 on the same
-box. Clients are threads issuing sequential streaming ``generate`` calls —
-the same call pattern a Serve replica sees from its actor threads — so the
-numbers include scheduler + admission overhead, not just device time.
+Round 1 (ISSUE 9): A/B of the slotted continuous-batching ``LLMEngine``
+against the same engine pinned to one slot (the batch-1 replica baseline it
+replaced): aggregate tokens/s and client-observed p50/p99 TTFT at
+concurrency 1/4/16 on the same box. Clients are threads issuing sequential
+streaming ``generate`` calls — the same call pattern a Serve replica sees
+from its actor threads — so the numbers include scheduler + admission
+overhead, not just device time.
 
-``--quick`` is the serve smoke path: it additionally deploys the engine
-through ``llm_deployment`` and streams concurrent requests over the full
-data plane (handle → pow-2 router → replica), checking the streaming
-response contract end to end.
+Round 2 (ISSUE 11): paged-vs-slotted A/B AT EQUAL SLOTS under prefix-heavy
+traffic — the workload paged KV + prefix reuse targets:
 
-Usage:: python benches/serve_llm.py [--quick] [--round 1]
+- ``shared_prefix``: every request = one fixed system prefix (half the
+  context) + a short unique user suffix. The paged engine prefills the
+  prefix once and serves the rest from cache.
+- ``multiturn``: each client runs N-turn conversations whose prompt is the
+  full prior history; the paged engine re-prefills only the newest turn.
+
+Paged rows record the measured cache hit rate; the headline metrics are
+``speedup_tokens_vs_slotted`` and ``ttft_p50_speedup_vs_slotted``.
+
+``--quick`` is the serve smoke path: a short A/B, a paged-engine COW-fork
+smoke, and a deploy through ``llm_deployment`` streaming concurrent
+requests over the full data plane (handle → pow-2 router → replica).
+
+Usage:: python benches/serve_llm.py [--quick] [--round 2]
 """
 
 from __future__ import annotations
@@ -122,6 +134,168 @@ def bench_modes(concurrencies, reps: int, slots: int, chunk: int) -> List[dict]:
     return results
 
 
+def _model(mid: bool = False):
+    import jax
+
+    from ray_tpu.models import transformer
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        cfg = transformer.gpt2_small(max_seq_len=256)
+    elif mid:
+        # Prefix-reuse A/B needs prefill COMPUTE to dominate dispatch
+        # overhead, or cached-prefix savings vanish into scheduler noise —
+        # a mid-size config keeps CPU runs honest and fast enough.
+        cfg = transformer.tiny(d_model=256, n_layers=4, n_heads=8,
+                               d_ff=1024, max_seq_len=128)
+    else:
+        cfg = transformer.tiny(max_seq_len=64)
+    return cfg, transformer.init_params(cfg, jax.random.key(0)), on_tpu
+
+
+def bench_traffic(eng, traffic: str, concurrency: int, reps: int,
+                  max_len: int) -> dict:
+    """Prefix-heavy traffic generator: ``shared_prefix`` requests reuse one
+    system prefix; ``multiturn`` conversations resend their full history
+    each turn. Token ids stay within the tiny vocab (256)."""
+    prefix = [(j * 13 + 5) % 250 + 1 for j in range(max_len // 2)]
+    user_len = max(2, max_len // 16)
+    turn_new = user_len + 2
+    turns = 3
+    ttfts: List[float] = []
+    counts = [0] * concurrency
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+
+    def one(i: int, prompt: List[int], n: int) -> List[int]:
+        t0 = time.perf_counter()
+        first = None
+        out = []
+        for tok in eng.stream(prompt, max_new_tokens=n):
+            if first is None:
+                first = time.perf_counter() - t0
+            out.append(tok)
+            counts[i] += 1
+        with lock:
+            ttfts.append(first)
+        return out
+
+    def client(i: int) -> None:
+        try:
+            if traffic == "shared_prefix":
+                sfx_len = max(2, max_len // 8)
+                for r in range(reps):
+                    sfx = [(i * 37 + r * 11 + j) % 250 + 1
+                           for j in range(sfx_len)]
+                    one(i, prefix + sfx, max_len // 8 + 4)
+            else:  # multiturn
+                short_prefix = prefix[:max_len // 4]
+                for r in range(reps):
+                    history = list(short_prefix)
+                    for turn in range(turns):
+                        history += [(i * 41 + r * 17 + turn * 5 + j) % 250 + 1
+                                    for j in range(user_len)]
+                        history += one(i, history, turn_new)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,), name=f"cli-{i}")
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return {
+        "requests": len(ttfts),
+        "tokens": sum(counts),
+        "tokens_per_s": round(sum(counts) / wall, 1),
+        "ttft_ms_p50": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "ttft_ms_p99": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+    }
+
+
+def bench_prefix_modes(concurrencies, reps: int, slots: int,
+                       chunk: int) -> List[dict]:
+    """ISSUE 11 A/B: paged (prefix cache + COW) vs slotted at EQUAL slots
+    under shared-prefix and multi-turn traffic."""
+    from ray_tpu.serve.llm import LLMEngine, PagedLLMEngine
+
+    cfg, params, on_tpu = _model(mid=True)
+    engines = {
+        "slotted": LLMEngine(params, cfg, chunk=chunk, slots=slots,
+                             max_queue=0, name="bench-slotted"),
+        "paged": PagedLLMEngine(params, cfg, chunk=chunk, slots=slots,
+                                max_queue=0, name="bench-paged"),
+    }
+    for eng in engines.values():
+        eng.warmup()
+    results = []
+    for conc in concurrencies:
+        for traffic in ("shared_prefix", "multiturn"):
+            base = {}
+            for mode, eng in engines.items():
+                kv0 = eng.kv.stats() if mode == "paged" else None
+                row = {
+                    "metric": "serve_llm_prefix",
+                    "mode": mode,
+                    "traffic": traffic,
+                    "slots": slots,
+                    "chunk": chunk,
+                    "concurrency": conc,
+                    **bench_traffic(eng, traffic, conc, reps,
+                                    cfg.max_seq_len),
+                    "platform": "tpu" if on_tpu else "cpu",
+                }
+                if mode == "slotted":
+                    base = row
+                else:
+                    kv1 = eng.kv.stats()
+                    hit = kv1["kv_hit_tokens"] - kv0["kv_hit_tokens"]
+                    miss = kv1["kv_miss_tokens"] - kv0["kv_miss_tokens"]
+                    row["kv_hit_rate"] = round(hit / max(1.0, hit + miss), 3)
+                    row["kv_cow_copies"] = kv1["kv_cow_copies"]
+                    row["speedup_tokens_vs_slotted"] = round(
+                        row["tokens_per_s"] / base["tokens_per_s"], 2)
+                    row["ttft_p50_speedup_vs_slotted"] = round(
+                        base["ttft_ms_p50"] / row["ttft_ms_p50"], 2)
+                print(json.dumps(row), flush=True)
+                results.append(row)
+    return results
+
+
+def smoke_paged_cow() -> dict:
+    """Quick smoke: the paged engine serves a conversation, then two COW
+    forks of its retired tail decode independently."""
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg, params, _on_tpu = _model()
+    eng = PagedLLMEngine(params, cfg, chunk=4, slots=2, max_queue=0,
+                         name="smoke-paged")
+    eng.warmup()
+    base = [(7 * j + 3) % 250 + 1 for j in range(12)]
+    chain = base + eng.generate(base, max_new_tokens=6)
+    forks = [eng.generate(chain + [50 + i, 51, 52], max_new_tokens=6)
+             for i in range(2)]
+    st = eng.kv.stats()
+    assert st["kv_hit_tokens"] > 0, "forks missed the retired chain"
+    assert st["kv_cow_copies"] >= 1, "no COW copy on tail fork"
+    assert eng.kv.active_blocks() == 0, "blocks leaked after retire"
+    assert forks[0] != forks[1] or forks[0], "fork outputs empty"
+    row = {
+        "metric": "serve_llm_paged_cow_smoke",
+        "kv_hit_tokens": st["kv_hit_tokens"],
+        "kv_cow_copies": st["kv_cow_copies"],
+        "ok": True,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def smoke_dataplane(concurrency: int = 4, reps: int = 2) -> dict:
     """Serve smoke: stream concurrent requests through the FULL data plane
     (handle → router → replica actor → engine) and check the contract."""
@@ -192,10 +366,14 @@ def main() -> int:
 
     if args.quick:
         results = bench_modes([4], reps=2, slots=4, chunk=args.chunk)
+        results += bench_prefix_modes([4], reps=2, slots=4, chunk=args.chunk)
+        results.append(smoke_paged_cow())
         results.append(smoke_dataplane())
     else:
         results = bench_modes([1, 4, 16], reps=args.reps,
                               slots=args.slots, chunk=args.chunk)
+        results += bench_prefix_modes([4, 16], reps=args.reps,
+                                      slots=args.slots, chunk=args.chunk)
 
     if args.round:
         path = os.path.join(

@@ -235,6 +235,37 @@ class Config:
     # of queueing unboundedly (the router also sheds when every replica
     # reports a queue this deep). 0 disables shedding.
     serve_admission_queue_limit = _Flag(32)
+    # Tokens per KV block in the PAGED cache (serve/llm.py PagedLLMEngine +
+    # models/generate.py PagedGenerator): sequences hold block TABLES into a
+    # shared pool instead of a private max_len slab, and prefix reuse /
+    # copy-on-write forks share at this granularity. Smaller blocks = finer
+    # sharing and less tail waste, more gather/scatter indices per dispatch.
+    serve_kv_block_tokens = _Flag(16)
+    # Total blocks in the shared KV pool (block 0 is a reserved trash block
+    # that absorbs pad/inactive writes). 0 = auto: 2x the blocks needed to
+    # hold every slot at max_len, so retired prefixes stay hash-cached for
+    # reuse instead of being evicted the moment a new request arrives.
+    serve_kv_pool_blocks = _Flag(0)
+    # Engine selection for llm_deployment: 1 serves replicas on the paged
+    # prefix-caching engine (PagedLLMEngine), 0 falls back to the PR 8
+    # slotted engine (LLMEngine). The streaming contract is identical; the
+    # paged engine adds hash-based prefix reuse and COW forks.
+    serve_kv_paged_enabled = _Flag(True)
+    # Prefill/decode disaggregation: 1 splits each llm_deployment replica
+    # into a prefill-specialized engine and a decode-specialized engine that
+    # exchange finished KV blocks over a multi-slot shm Channel lane
+    # (deferred-ack handoff, serve/dag_pipeline.py KVHandoffLane). 0 (the
+    # default) keeps the colocated engine — byte-identical to PR 8 behavior.
+    serve_disaggregation_enabled = _Flag(False)
+    # Router prefix affinity: 1 makes DeploymentHandle hash the prompt's
+    # leading KV blocks and prefer the replica that served that prefix last
+    # (its pool likely still caches those blocks), layered on the
+    # KV-occupancy pow-2 pick; saturated/dead replicas fall back to pow-2.
+    serve_prefix_affinity_enabled = _Flag(True)
+    # How many leading serve_kv_block_tokens-sized blocks of the prompt feed
+    # the affinity hash. Smaller = coarser grouping (more traffic lands on
+    # one replica), larger = only near-identical prompts share a replica.
+    serve_prefix_affinity_blocks = _Flag(4)
 
     # -- metrics / observability ----------------------------------------------
     # Cluster-wide metrics pipeline: every process (gcs_server, node_daemon,

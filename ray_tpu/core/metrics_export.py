@@ -102,14 +102,29 @@ def serve_request_hist() -> um.Histogram:
 def serve_ttft_hist() -> um.Histogram:
     return _metric(
         um.Histogram, "ray_tpu_serve_ttft_s",
-        "LLM serving time-to-first-token (request submit to first token)",
-        boundaries=_LATENCY_BOUNDS, tag_keys=("deployment",))
+        "LLM serving time-to-first-token (request submit to first token), "
+        "phase-split: total | queued | prefill | decode",
+        boundaries=_LATENCY_BOUNDS, tag_keys=("deployment", "phase"))
 
 
 def serve_tokens_total() -> um.Counter:
     return _metric(um.Counter, "ray_tpu_serve_tokens_total",
                    "LLM serving decoded tokens delivered to requests",
                    tag_keys=("deployment",))
+
+
+def serve_kv_hit_tokens_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_serve_kv_hit_tokens_total",
+                   "Prompt tokens served from the paged KV prefix cache "
+                   "(prefill FLOPs avoided)",
+                   tag_keys=("deployment",))
+
+
+def serve_kv_block_occupancy() -> um.Gauge:
+    return _metric(um.Gauge, "ray_tpu_serve_kv_block_occupancy",
+                   "Paged KV pool blocks by state "
+                   "(active=pinned, cached=prefix-reusable, free)",
+                   tag_keys=("deployment", "state"))
 
 
 def dag_tick_hist() -> um.Histogram:

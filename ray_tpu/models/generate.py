@@ -16,7 +16,7 @@ host; batch/beam layouts stay static so both programs compile exactly once.
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -290,6 +290,523 @@ class SlottedGenerator:
 
         self._decode_fns[chunk] = decode_chunk
         return decode_chunk
+
+
+def init_block_pool(config: TransformerConfig, num_blocks: int,
+                    block_tokens: int) -> Tuple[jax.Array, jax.Array]:
+    """Shared paged KV pool: ``num_blocks`` fixed-size blocks of
+    ``block_tokens`` K/V rows each, shared by every sequence through
+    per-sequence block TABLES instead of private max_len slabs. Block 0 is
+    the reserved TRASH block: freed table rows and pad positions point at
+    it, so out-of-range scatter writes land somewhere harmless instead of
+    corrupting a live sequence."""
+    c = config
+    shape = (c.n_layers, num_blocks, block_tokens, c.n_heads, c.head_dim)
+    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+
+def _forward_prefill_paged(params, tokens, k_pool, v_pool, table, start_pos,
+                           suffix_len, config: TransformerConfig,
+                           block_tokens: int):
+    """Prefill ``tokens`` [1, P] (a SUFFIX bucket) at absolute positions
+    [start_pos, start_pos+P) into the paged pool through ``table`` [NB].
+
+    Prefix reuse is what makes ``start_pos`` nonzero: positions below it
+    were written by earlier sequences sharing the same blocks, so attention
+    gathers them back through the table without recomputing. Only the first
+    ``suffix_len`` positions are real — pad writes redirect to trash block
+    0 and pad queries are causally ahead of every real row, so their
+    garbage never reaches a real position's softmax."""
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    B, P = tokens.shape  # B == 1
+    NB = table.shape[0]
+    bt = block_tokens
+    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    positions = start_pos + jnp.arange(P)
+    if c.pos == "learned":
+        h = h + cast(params["pos_embed"])[jnp.minimum(
+            positions, c.max_seq_len - 1)][None]
+    scale = 1.0 / c.head_dim**0.5
+    valid_len = start_pos + P
+    write_ok = jnp.arange(P) < suffix_len
+    blk = jnp.where(write_ok,
+                    table[jnp.clip(positions // bt, 0, NB - 1)], 0)
+    off = positions % bt
+
+    for layer in range(c.n_layers):
+        bp = jax.tree.map(lambda p: cast(p[layer]), params["blocks"])
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("btd,dhk->bthk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        k = jnp.einsum("btd,dhk->bthk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        v = jnp.einsum("btd,dhk->bthk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            q = rope(q, positions[None])
+            k = rope(k, positions[None])
+        k_pool = k_pool.at[layer, blk, off].set(k[0])
+        v_pool = v_pool.at[layer, blk, off].set(v[0])
+        kc = k_pool[layer][table].reshape(1, NB * bt, c.n_heads, c.head_dim)
+        vc = v_pool[layer][table].reshape(1, NB * bt, c.n_heads, c.head_dim)
+        o = _attend_cached(q, kc, vc, valid_len, scale=scale)
+        o = jnp.einsum("bthk,hkd->btd", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
+        h = h + o
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = gelu(linear(x, bp["w_up"], bp["b_up"]))
+        h = h + linear(u, bp["w_down"], bp["b_down"])
+
+    h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
+    w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", h, cast(w_out), preferred_element_type=jnp.float32)
+    return logits, k_pool, v_pool
+
+
+def _forward_decode_paged(params, tokens, k_pool, v_pool, tables, lengths,
+                          config: TransformerConfig, block_tokens: int):
+    """One decode step for S sequences over the paged pool: ``tokens``
+    [S, 1] at per-slot positions ``lengths`` [S], each slot's K/V scattered
+    into block ``tables[s, pos // bt]`` row ``pos % bt`` and attention
+    gathered back through its table row. Inactive slots carry all-trash
+    tables, so their writes land in block 0 and their outputs are dead."""
+    c = config
+    cast = lambda p: p.astype(c.dtype)
+    S, T = tokens.shape  # T == 1
+    NB = tables.shape[1]
+    bt = block_tokens
+    max_len = NB * bt
+    h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
+    pos = jnp.minimum(lengths, max_len - 1)
+    positions = pos[:, None]
+    if c.pos == "learned":
+        h = h + cast(params["pos_embed"])[positions]
+    scale = 1.0 / c.head_dim**0.5
+    rows = jnp.arange(S)
+    blk = tables[rows, pos // bt]
+    off = pos % bt
+    valid_len = pos + 1
+
+    for layer in range(c.n_layers):
+        bp = jax.tree.map(lambda p: cast(p[layer]), params["blocks"])
+        x = layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+        q = jnp.einsum("btd,dhk->bthk", x, bp["wq"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bq"]
+        k = jnp.einsum("btd,dhk->bthk", x, bp["wk"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bk"]
+        v = jnp.einsum("btd,dhk->bthk", x, bp["wv"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bv"]
+        if c.pos == "rope":
+            q = rope(q, positions)
+            k = rope(k, positions)
+        k_pool = k_pool.at[layer, blk, off].set(k[:, 0])
+        v_pool = v_pool.at[layer, blk, off].set(v[:, 0])
+        kc = k_pool[layer][tables].reshape(S, max_len, c.n_heads, c.head_dim)
+        vc = v_pool[layer][tables].reshape(S, max_len, c.n_heads, c.head_dim)
+        o = _attend_cached(q, kc, vc, valid_len, scale=scale)
+        o = jnp.einsum("bthk,hkd->btd", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
+        h = h + o
+        x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+        u = gelu(linear(x, bp["w_up"], bp["b_up"]))
+        h = h + linear(u, bp["w_down"], bp["b_down"])
+
+    h = layer_norm(h, cast(params["lnf_g"]), cast(params["lnf_b"]))
+    w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", h, cast(w_out), preferred_element_type=jnp.float32)
+    return logits, k_pool, v_pool
+
+
+class PagedGenerator:
+    """Paged device half of the serving engine: same compile discipline as
+    :class:`SlottedGenerator` (one program per prompt bucket, one per chunk
+    size), but K/V lives in a SHARED block pool addressed through
+    per-sequence block tables — the layout that makes hash-based prefix
+    reuse, copy-on-write forks and prefill/decode KV handoff possible.
+
+    Device state is ``(k_pool, v_pool, last, keys)`` threaded with buffer
+    donation; block tables and per-slot lengths are plain numpy operands
+    owned by the host-side :class:`KVBlockManager` + engine.
+    """
+
+    def __init__(self, params, config: TransformerConfig, *, slots: int,
+                 num_blocks: int, block_tokens: int,
+                 max_len: Optional[int] = None):
+        self.params = params
+        self.config = config
+        self.slots = slots
+        self.max_len = max_len or config.max_seq_len
+        self.block_tokens = int(block_tokens)
+        if self.max_len % self.block_tokens:
+            raise ValueError(
+                f"max_len {self.max_len} not a multiple of "
+                f"serve_kv_block_tokens {self.block_tokens}")
+        self.blocks_per_seq = self.max_len // self.block_tokens
+        self.num_blocks = int(num_blocks)
+        self.logits_dim = (params["tok_embed"].shape[0]
+                          if config.tie_embeddings
+                          else params["lm_head"].shape[-1])
+        self._prefill_fns = {}   # suffix bucket -> jitted paged prefill
+        self._decode_fns = {}    # chunk -> jitted paged decode
+        self._extract_fns = {}   # nb -> jitted block gather (KV handoff out)
+        self._insert_fns = {}    # nb -> jitted block scatter (KV handoff in)
+        self._copy_fn = None
+
+    def init_state(self):
+        k_pool, v_pool = init_block_pool(self.config, self.num_blocks,
+                                         self.block_tokens)
+        last = jnp.zeros((self.slots, self.logits_dim), jnp.float32)
+        keys = jnp.zeros((self.slots, 2), jnp.uint32)
+        return k_pool, v_pool, last, keys
+
+    def prefill_fn(self, bucket: int):
+        """paged_prefill(params, k_pool, v_pool, last, keys, table [NB],
+        padded [1,P], start_pos, suffix_len, slot, seed) -> (k_pool, v_pool,
+        last, keys): prefill the SUFFIX bucket at start_pos (the prefix-hit
+        length) and park last-token logits + PRNG key in the slot rows."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        c = self.config
+        bt = self.block_tokens
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def paged_prefill(params, k_pool, v_pool, last, keys, table, padded,
+                          start_pos, suffix_len, slot, seed):
+            logits, k_pool, v_pool = _forward_prefill_paged(
+                params, padded, k_pool, v_pool, table, start_pos,
+                suffix_len, c, bt)
+            row = jax.lax.dynamic_index_in_dim(
+                logits, suffix_len - 1, axis=1, keepdims=False)     # [1, V]
+            last = lax.dynamic_update_slice(last, row, (slot, 0))
+            keys = lax.dynamic_update_slice(
+                keys, jax.random.PRNGKey(seed)[None], (slot, 0))
+            return k_pool, v_pool, last, keys
+
+        self._prefill_fns[bucket] = paged_prefill
+        return paged_prefill
+
+    def decode_fn(self, chunk: int):
+        """paged_decode(params, k_pool, v_pool, last, keys, tables [S,NB],
+        lengths [S], active, greedy, temps) -> (toks [S, chunk], k_pool,
+        v_pool, last, keys): ``chunk`` scan steps advancing every active
+        slot through its block table in one dispatch."""
+        fn = self._decode_fns.get(chunk)
+        if fn is not None:
+            return fn
+        c = self.config
+        bt = self.block_tokens
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def paged_decode(params, k_pool, v_pool, last, keys, tables, lengths,
+                         active, greedy, temps):
+            adv = active.astype(jnp.int32)
+            act_col = active[:, None]
+            temp_safe = jnp.maximum(temps, 1e-6)[:, None]
+
+            def step(carry, _):
+                k_p, v_p, lens, last, keys = carry
+                real = last[:, : c.vocab_size]
+                split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
+                keys2, subs = split[:, 0], split[:, 1]
+                samp = jax.vmap(jax.random.categorical)(subs, real / temp_safe)
+                nxt = jnp.where(greedy, jnp.argmax(real, axis=-1),
+                                samp).astype(jnp.int32)
+                logits, k_p, v_p = _forward_decode_paged(
+                    params, nxt[:, None], k_p, v_p, tables, lens, c, bt)
+                lens = lens + adv
+                last = jnp.where(act_col, logits[:, -1], last)
+                keys = jnp.where(act_col, keys2, keys)
+                return (k_p, v_p, lens, last, keys), nxt
+
+            (k_pool, v_pool, _lens, last, keys), toks = lax.scan(
+                step, (k_pool, v_pool, jnp.asarray(lengths), last, keys),
+                None, length=chunk)
+            return toks.T, k_pool, v_pool, last, keys
+
+        self._decode_fns[chunk] = paged_decode
+        return paged_decode
+
+    def copy_fn(self):
+        """copy_block(k_pool, v_pool, src, dst) -> (k_pool, v_pool): the
+        copy-on-write primitive — duplicate one shared block (a prefix-hit
+        partial tail) into a private block before divergent writes."""
+        if self._copy_fn is None:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def copy_block(k_pool, v_pool, src, dst):
+                k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+                v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+                return k_pool, v_pool
+
+            self._copy_fn = copy_block
+        return self._copy_fn
+
+    def extract_fn(self, nb: int):
+        """extract(k_pool, v_pool, block_ids [nb]) -> (k [L,nb,bt,H,Dh], v):
+        gather a finished prefill's blocks for the disaggregation handoff
+        (the pool itself is NOT donated — the prefill engine keeps serving
+        its prefix cache from it)."""
+        fn = self._extract_fns.get(nb)
+        if fn is None:
+
+            @jax.jit
+            def extract(k_pool, v_pool, block_ids):
+                return k_pool[:, block_ids], v_pool[:, block_ids]
+
+            fn = self._extract_fns[nb] = extract
+        return fn
+
+    def insert_fn(self, nb: int):
+        """insert(k_pool, v_pool, k [L,nb,bt,H,Dh], v, block_ids [nb]) ->
+        (k_pool, v_pool): scatter handed-off blocks into the decode pool —
+        donated, so the upload lands in place of the old pool buffers."""
+        fn = self._insert_fns.get(nb)
+        if fn is None:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def insert(k_pool, v_pool, k, v, block_ids):
+                k_pool = k_pool.at[:, block_ids].set(k)
+                v_pool = v_pool.at[:, block_ids].set(v)
+                return k_pool, v_pool
+
+            fn = self._insert_fns[nb] = insert
+        return fn
+
+    def set_last_fn(self):
+        """set_last(last, keys, row [V], slot, seed) -> (last, keys): park a
+        handed-off request's next-token logits + PRNG key in its decode
+        slot (the decode-side half of the prefill handoff)."""
+        if not hasattr(self, "_set_last_fn") or self._set_last_fn is None:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def set_last(last, keys, row, slot, seed):
+                last = lax.dynamic_update_slice(last, row[None], (slot, 0))
+                keys = lax.dynamic_update_slice(
+                    keys, jax.random.PRNGKey(seed)[None], (slot, 0))
+                return last, keys
+
+            self._set_last_fn = set_last
+        return self._set_last_fn
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool cannot supply an allocation even after evicting every
+    unpinned cached block — the caller should keep the request queued."""
+
+
+class KVBlockManager:
+    """Host-side bookkeeping for the paged KV pool: free list, refcounts,
+    and the prefix-reuse hash table.
+
+    Block states (block 0, the trash block, is never managed):
+
+    - FREE: on ``_free``, content garbage;
+    - ACTIVE: refcount > 0, pinned by one or more live sequences;
+    - CACHED: refcount 0 but hash-retained — the block's content is a
+      registered prefix and future lookups may hit it; evicted LRU-first
+      when the free list runs dry.
+
+    Full blocks are keyed by the chained digest of the token prefix ending
+    at them (``util.blockhash``); a retired sequence's PARTIAL tail block is
+    additionally keyed by ``(parent_digest, tail_token_tuple)`` so a
+    follow-up turn (history + new text) can reuse it — hit tail blocks are
+    handed out COPY-ON-WRITE (the engine duplicates them via
+    ``PagedGenerator.copy_fn`` before the divergent suffix writes into
+    them; full hit blocks are read-only to every sharer and share by
+    refcount alone).
+
+    Thread-safe behind one internal lock; never calls out while holding it
+    (safe under the engine's state lock — lock order: engine state → here).
+    """
+
+    def __init__(self, num_blocks: int, block_tokens: int):
+        import collections as _c
+        import threading as _t
+
+        if num_blocks < 2:
+            raise ValueError("pool needs at least one block beyond trash")
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self._lock = _t.Lock()
+        self._free = _c.deque(range(1, num_blocks))
+        self._ref: Dict[int, int] = {}
+        self._by_hash: Dict[bytes, int] = {}       # full-block digest -> id
+        self._hash_of: Dict[int, bytes] = {}
+        self._tail_by_key: Dict[tuple, int] = {}   # (parent, tokens) -> id
+        self._tail_key_of: Dict[int, tuple] = {}
+        # CACHED blocks in LRU order (oldest first).
+        self._cached: "Dict[int, None]" = {}
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.cow_copies = 0
+
+    # -- allocation -----------------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks off the free list, evicting LRU cached blocks
+        (dropping their hash entries) as needed; raises :class:`NoFreeBlocks`
+        without side effects when the pool can't supply them."""
+        with self._lock:
+            if len(self._free) + len(self._cached) < n:
+                raise NoFreeBlocks(
+                    f"need {n} blocks; {len(self._free)} free + "
+                    f"{len(self._cached)} cached of {self.num_blocks - 1}")
+            out = []
+            for _ in range(n):
+                if self._free:
+                    b = self._free.popleft()
+                else:
+                    b = next(iter(self._cached))   # LRU head
+                    self._drop_cached_locked(b)
+                self._ref[b] = 1
+                out.append(b)
+            return out
+
+    def _drop_cached_locked(self, b: int) -> None:
+        self._cached.pop(b, None)
+        d = self._hash_of.pop(b, None)
+        if d is not None and self._by_hash.get(d) == b:
+            del self._by_hash[d]
+        tk = self._tail_key_of.pop(b, None)
+        if tk is not None and self._tail_by_key.get(tk) == b:
+            del self._tail_by_key[tk]
+
+    def release(self, block_ids: Sequence[int]) -> None:
+        """Unpin blocks; at refcount 0 a hash-registered block becomes
+        CACHED (reusable by future lookups, LRU-evictable), an unregistered
+        one goes straight back to the free list."""
+        with self._lock:
+            for b in block_ids:
+                r = self._ref.get(b, 0) - 1
+                if r > 0:
+                    self._ref[b] = r
+                    continue
+                self._ref.pop(b, None)
+                if b in self._hash_of or b in self._tail_key_of:
+                    self._cached.pop(b, None)
+                    self._cached[b] = None         # move to MRU end
+                else:
+                    self._free.append(b)
+
+    # -- prefix reuse ---------------------------------------------------------
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], Optional[int], int]:
+        """Longest reusable prefix of ``tokens``: returns ``(full_blocks,
+        tail_block, hit_len)`` with every returned block PINNED (refcount
+        bumped; caller must ``release`` them with the sequence). hit_len is
+        capped at ``len(tokens) - 1`` so at least one suffix token is always
+        recomputed — prefill must produce last-token logits.
+
+        ``tail_block`` (a retired sequence's partial last block) is SHARED
+        CONTENT: the caller must copy it before writing (COW)."""
+        from ray_tpu.util import blockhash
+
+        bt = self.block_tokens
+        cap = len(tokens) - 1
+        if cap <= 0:
+            return [], None, 0
+        digests = blockhash.block_hashes(tokens, bt, max_blocks=cap // bt)
+        with self._lock:
+            full: List[int] = []
+            parent = blockhash.SEED
+            for d in digests:
+                b = self._by_hash.get(d)
+                if b is None:
+                    break
+                full.append(b)
+                parent = d
+            k = len(full)
+            hit_len = k * bt
+            tail = None
+            for t in range(min(bt - 1, cap - hit_len), 0, -1):
+                key = (parent, tuple(int(x) for x in
+                                     tokens[hit_len:hit_len + t]))
+                b = self._tail_by_key.get(key)
+                if b is not None:
+                    tail = b
+                    hit_len += t
+                    break
+            for b in full + ([tail] if tail is not None else []):
+                if self._ref.get(b, 0) == 0:
+                    self._cached.pop(b, None)      # CACHED -> ACTIVE
+                self._ref[b] = self._ref.get(b, 0) + 1
+            self.hit_tokens += hit_len
+            self.miss_tokens += len(tokens) - hit_len
+            return full, tail, hit_len
+
+    def register_chain(self, tokens: Sequence[int], block_ids: Sequence[int],
+                       n_real: int) -> None:
+        """Publish a sequence's blocks into the reuse table: every block
+        fully covered by the first ``n_real`` REAL tokens gets its chained
+        digest, and the partial remainder (if any) gets a tail entry.
+        First registration wins — a concurrent sequence that produced the
+        same prefix keeps the existing mapping and its own blocks simply
+        retire unhashed."""
+        from ray_tpu.util import blockhash
+
+        bt = self.block_tokens
+        n_full = min(n_real // bt, len(block_ids))
+        digests = blockhash.block_hashes(tokens[:n_real], bt,
+                                         max_blocks=n_full)
+        with self._lock:
+            parent = blockhash.SEED
+            for i, d in enumerate(digests):
+                b = block_ids[i]
+                if d not in self._by_hash and b not in self._hash_of \
+                        and b not in self._tail_key_of:
+                    self._by_hash[d] = b
+                    self._hash_of[b] = d
+                parent = d
+            t = n_real - n_full * bt
+            if t > 0 and n_full < len(block_ids):
+                b = block_ids[n_full]
+                key = (parent, tuple(int(x) for x in
+                                     tokens[n_full * bt:n_real]))
+                if key not in self._tail_by_key and b not in self._hash_of \
+                        and b not in self._tail_key_of:
+                    self._tail_by_key[key] = b
+                    self._tail_key_of[b] = key
+
+    def peek_hit_len(self, tokens: Sequence[int]) -> int:
+        """Advisory hit length: same walk as :meth:`lookup` but pins nothing
+        and skips the counters — the engine's admission-budget estimate."""
+        from ray_tpu.util import blockhash
+
+        bt = self.block_tokens
+        cap = len(tokens) - 1
+        if cap <= 0:
+            return 0
+        digests = blockhash.block_hashes(tokens, bt, max_blocks=cap // bt)
+        with self._lock:
+            hit_len = 0
+            parent = blockhash.SEED
+            for d in digests:
+                if d not in self._by_hash:
+                    break
+                hit_len += bt
+                parent = d
+            for t in range(min(bt - 1, cap - hit_len), 0, -1):
+                key = (parent, tuple(int(x) for x in
+                                     tokens[hit_len:hit_len + t]))
+                if key in self._tail_by_key:
+                    return hit_len + t
+            return hit_len
+
+    def note_cow(self) -> None:
+        with self._lock:
+            self.cow_copies += 1
+
+    # -- introspection --------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            active = len(self._ref)
+            return {
+                "kv_blocks_total": float(self.num_blocks - 1),
+                "kv_blocks_active": float(active),
+                "kv_blocks_cached": float(len(self._cached)),
+                "kv_blocks_free": float(len(self._free)),
+                "kv_hit_tokens": float(self.hit_tokens),
+                "kv_miss_tokens": float(self.miss_tokens),
+                "kv_cow_copies": float(self.cow_copies),
+            }
+
+    def active_blocks(self) -> int:
+        """Blocks pinned by live sequences — must drop to 0 when every
+        request retires (the leak-check invariant)."""
+        with self._lock:
+            return len(self._ref)
 
 
 class Generator:

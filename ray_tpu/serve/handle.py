@@ -128,9 +128,12 @@ class DeploymentResponseGenerator:
 
 
 class Router:
-    """Pow-2-choices with client-side ongoing tracking."""
+    """Pow-2-choices with client-side ongoing tracking and prefix affinity."""
 
     SNAPSHOT_MAX_AGE_S = 1.0
+    # Bound on the prefix-hash -> replica affinity map (LRU-evicted): enough
+    # for every live conversation prefix without growing with total traffic.
+    AFFINITY_CAP = 4096
 
     def __init__(self, controller, deployment_name: str):
         self._controller = controller
@@ -146,6 +149,14 @@ class Router:
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._refresh(block=True)
+
+    def _affinity_map(self) -> Dict[bytes, str]:
+        """Prefix-hash -> replica-key map, insertion-ordered (LRU via
+        re-insert). Lazily created: unit tests build routers via __new__."""
+        m = self.__dict__.get("_affinity")
+        if m is None:
+            m = self.__dict__["_affinity"] = {}
+        return m
 
     # -- replica set maintenance --------------------------------------------
     def _refresh(self, block: bool = False) -> None:
@@ -164,7 +175,20 @@ class Router:
                     self._replicas = entry["replicas"]
                     self._max_ongoing = entry["max_ongoing_requests"]
                     self._model_ids = entry.get("model_ids", {})
-                    self._replica_load = entry.get("replica_load", {})
+                    # Evict state for replicas that left the snapshot: a
+                    # stale load/ongoing entry (or affinity pin) would keep
+                    # winning — or losing — the pow-2 pick for a replica
+                    # that no longer exists.
+                    live = {self._key(r) for r in entry["replicas"]}
+                    self._replica_load = {
+                        k: v
+                        for k, v in entry.get("replica_load", {}).items()
+                        if k in live}
+                    for k in [k for k in self._ongoing if k not in live]:
+                        del self._ongoing[k]
+                    aff = self._affinity_map()
+                    for h in [h for h, k in aff.items() if k not in live]:
+                        del aff[h]
                 self._last_refresh = now
                 return
             if not block or time.monotonic() > deadline:
@@ -211,15 +235,31 @@ class Router:
                 return False
         return True
 
-    def _pick(self, model_id: str = ""):
+    def _note_affinity(self, prefix_hash: bytes, key: str) -> None:
+        """Record (under ``_lock``) that ``key`` now holds this prefix's KV
+        blocks; re-insert for LRU order, evict oldest past AFFINITY_CAP."""
+        aff = self._affinity_map()
+        aff.pop(prefix_hash, None)
+        aff[prefix_hash] = key
+        while len(aff) > self.AFFINITY_CAP:
+            del aff[next(iter(aff))]
+
+    def _pick(self, model_id: str = "",
+              prefix_hash: Optional[bytes] = None):
         """Pow-2: sample two replicas, choose the lower client-side queue —
         replicas reporting FREE KV slots beat replicas reporting a full slot
         set (occupancy-aware tie-break ahead of the ongoing count). With a
         ``model_id``, replicas that already hold the model are preferred
         (pow_2_scheduler.py:127-135) — cold replicas only load it when every
-        warm one is saturated. Blocks (with periodic refresh) while all
-        candidates are saturated, unless every replica also reports an
-        over-limit admission queue — then sheds with ``Saturated``."""
+        warm one is saturated. A ``prefix_hash`` (leading prompt blocks,
+        keyed exactly as the engines' KV block managers hash them) is
+        layered ON TOP: the replica that last served this prefix still holds
+        its KV blocks, so it wins outright unless it reports a full slot set
+        or is at max_ongoing — then the pow-2 pick runs and INHERITS the
+        affinity, migrating the prefix to the new replica. Blocks (with
+        periodic refresh) while all candidates are saturated, unless every
+        replica also reports an over-limit admission queue — then sheds with
+        ``Saturated``."""
         from ray_tpu.serve.errors import Saturated
 
         deadline = time.monotonic() + 60.0
@@ -230,11 +270,24 @@ class Router:
                 warm_keys = {
                     k for k, ids in self._model_ids.items() if model_id in ids
                 } if model_id else set()
+                aff_key = (self._affinity_map().get(prefix_hash)
+                           if prefix_hash is not None else None)
             if replicas:
                 if self._all_shedding(replicas):
                     raise Saturated(
                         f"deployment {self._name}: every replica's admission "
                         "queue is over serve_admission_queue_limit")
+                if aff_key is not None and not self._slots_exhausted(aff_key):
+                    pref = next((r for r in replicas
+                                 if self._key(r) == aff_key), None)
+                    if pref is not None:
+                        with self._lock:
+                            if self._ongoing.get(aff_key, 0) < \
+                                    self._max_ongoing:
+                                self._ongoing[aff_key] = \
+                                    self._ongoing.get(aff_key, 0) + 1
+                                self._note_affinity(prefix_hash, aff_key)
+                                return pref, aff_key
                 pool = replicas
                 if model_id:
                     warm = [r for r in replicas if self._key(r) in warm_keys]
@@ -255,6 +308,8 @@ class Router:
                 with self._lock:
                     if self._ongoing.get(key, 0) < self._max_ongoing:
                         self._ongoing[key] = self._ongoing.get(key, 0) + 1
+                        if prefix_hash is not None:
+                            self._note_affinity(prefix_hash, key)
                         return best, key
             if time.monotonic() > deadline:
                 raise TimeoutError(f"no capacity on deployment {self._name}")
@@ -326,6 +381,32 @@ class DeploymentHandle:
         tracing.emit("serve.router_pick", req_ctx, duration=elapsed_s,
                      attrs=attrs)
 
+    @staticmethod
+    def _affinity_hash(args) -> Optional[bytes]:
+        """Block-aligned hash of the payload prompt's leading blocks — the
+        same keying the engines' KV block managers use, so "the replica that
+        holds this prefix" agrees with the cache byte-for-byte. None (no
+        affinity) for non-LLM payloads, sub-block prompts, or when the knob
+        is off."""
+        if not args or not isinstance(args[0], dict):
+            return None
+        prompt = args[0].get("prompt_ids")
+        if not prompt:
+            return None
+        from ray_tpu.core.config import config
+        from ray_tpu.util.blockhash import prefix_head_hash
+
+        try:
+            cfg = config()
+            if not cfg.serve_prefix_affinity_enabled:
+                return None
+            return prefix_head_hash(
+                [int(t) for t in prompt],
+                int(cfg.serve_kv_block_tokens),
+                int(cfg.serve_prefix_affinity_blocks))
+        except Exception:  # noqa: BLE001 — affinity is advisory, never fatal
+            return None
+
     def remote(self, *args, **kwargs):
         from ray_tpu.util import tracing
 
@@ -334,10 +415,11 @@ class DeploymentHandle:
         sampled = req_ctx is not None and req_ctx[2]
         submit_t = time.time()
         t0 = time.monotonic()
+        prefix_hash = self._affinity_hash(args)
         try:
             if req_ctx is not None:
                 tracing.set_context(req_ctx)
-            replica, key = self._router._pick(model_id)
+            replica, key = self._router._pick(model_id, prefix_hash)
             if sampled:
                 self._emit_pick_span(req_ctx, key, time.monotonic() - t0)
                 kwargs["_trace_submit_ts"] = time.time()
@@ -352,8 +434,9 @@ class DeploymentHandle:
                     trace=(parent_ctx, req_ctx, submit_t))
             ref = replica.handle_request.remote(self._method, *args, **kwargs)
 
-            def resubmit(method=self._method, a=args, kw=kwargs, mid=model_id):
-                rep, k = self._router._pick(mid)
+            def resubmit(method=self._method, a=args, kw=kwargs,
+                         mid=model_id, ph=prefix_hash):
+                rep, k = self._router._pick(mid, ph)
                 return rep.handle_request.remote(method, *a, **kw), k
 
             return DeploymentResponse(ref, self._router, key,

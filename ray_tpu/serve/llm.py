@@ -39,7 +39,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.models.generate import SlottedGenerator
+from ray_tpu.models.generate import (KVBlockManager, NoFreeBlocks,
+                                     PagedGenerator, SlottedGenerator)
 from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.serve.errors import Saturated
 from ray_tpu.util import tracing
@@ -52,6 +53,17 @@ def _default_buckets(max_len: int) -> List[int]:
         b *= 2
     buckets.append(max_len)
     return buckets
+
+
+def _check_token_ids(prompt: np.ndarray, vocab: int, name: str) -> None:
+    """Reject out-of-range token ids at admission. Under jit an out-of-range
+    embedding gather fills with NaN, and with a SHARED paged pool that NaN
+    outlives the offending request (it spills into the trash block and its
+    sequence's cached blocks, poisoning masked reads of every later request
+    on the pool) — so a bad id must never reach the device."""
+    if int(prompt.min()) < 0 or int(prompt.max()) >= vocab:
+        raise ValueError(
+            f"engine {name}: prompt token ids must be in [0, {vocab})")
 
 
 class _Request:
@@ -67,7 +79,8 @@ class _Request:
         "prompt", "padded", "real_len", "bucket", "max_new", "temperature",
         "seed", "tokens", "cond", "slot", "emitted", "done", "cancelled",
         "error", "finish_reason", "decode_tokens", "decode_seconds",
-        "submitted_at", "ttft_s", "trace_ctx",
+        "submitted_at", "ttft_s", "trace_ctx", "queued_s", "prefill_s",
+        "out_ids", "blocks", "hit_tokens", "preloaded",
     )
 
     def __init__(self, prompt, padded, real_len, bucket, max_new,
@@ -91,6 +104,20 @@ class _Request:
         self.decode_seconds = 0.0
         self.submitted_at = time.perf_counter()
         self.ttft_s: Optional[float] = None
+        # TTFT decomposition (metrics phase labels): submit→admission and
+        # the prefill dispatch, stamped by the scheduler.
+        self.queued_s = 0.0
+        self.prefill_s = 0.0
+        # Every delivered token id, in order — the paged engine registers
+        # the finished prompt+output chain in the prefix cache at retire.
+        self.out_ids: List[int] = []
+        # Paged-engine state: pool blocks pinned for a QUEUED request that
+        # already owns them (disaggregation handoff), prefix-cache hit size,
+        # and — for handed-off requests — the prefill's last-token logits
+        # row (None means prefill runs locally at admission).
+        self.blocks: List[int] = []
+        self.hit_tokens = 0
+        self.preloaded: Optional[np.ndarray] = None
         # Captured at submit time on the request's own thread; engine spans
         # must use THIS explicit context (the step loop runs on whichever
         # thread won the driver election — its ambient context belongs to a
@@ -133,9 +160,7 @@ class LLMEngine:
                              else knobs.serve_admission_queue_limit)
         self.prefill_budget = int(knobs.serve_llm_prefill_tokens)
         self.name = name
-        self._sg = SlottedGenerator(params, config, slots=self.slots,
-                                    max_len=self.max_len)
-        self._cache, self._last, self._keys = self._sg.init_state()
+        self._init_device()
 
         # Lock order: _step_lock (try-acquired, never under others) →
         # _state_lock (request/slot bookkeeping; also every req.cond) →
@@ -156,6 +181,59 @@ class LLMEngine:
         self.decode_tokens = 0
         self.decode_seconds = 0.0
         self.finish_reason = "stop"  # convenience; races under concurrency
+
+    # -- device-half hooks (the paged engine overrides these) -----------------
+    # The scheduler above them — admission budget, slot bookkeeping, token
+    # distribution, the streaming contract — is engine-agnostic; everything
+    # cache-layout-specific funnels through this narrow seam.
+    def _init_device(self) -> None:
+        self._sg = SlottedGenerator(self.params, self.config,
+                                    slots=self.slots, max_len=self.max_len)
+        self._cache, self._last, self._keys = self._sg.init_state()
+
+    def _reset_device_state(self) -> None:
+        self._cache, self._last, self._keys = self._sg.init_state()
+
+    def _admission_cost(self, req: _Request) -> int:
+        """Prefill tokens this admission charges against the step budget
+        (called under _state_lock)."""
+        return req.bucket
+
+    def _dispatch_prefill(self, req: _Request, slot: int) -> None:
+        """Run the prompt's prefill into ``slot``. May raise
+        :class:`NoFreeBlocks` (paged pool exhausted) — the scheduler requeues
+        the request at the head and stops admitting this step."""
+        pf = self._sg.prefill_fn(req.bucket)
+        self._cache, self._last, self._keys = pf(
+            self.params, self._cache, self._last, self._keys,
+            req.padded, req.real_len, slot, req.seed)
+
+    def _decode_operands_locked(self):
+        """Extra decode operands snapshotted under _state_lock (the paged
+        engine's block tables/lengths — mutated by cancel paths, so they
+        must be captured atomically with the active mask)."""
+        return None
+
+    def _run_decode(self, active, greedy, temps, extra):
+        df = self._sg.decode_fn(self.chunk)
+        toks, self._cache, self._last, self._keys = df(
+            self.params, self._cache, self._last, self._keys,
+            active, greedy, temps)
+        return toks
+
+    def _release_slot_device(self, slot: int) -> None:
+        """Per-slot device-side cleanup when a slot frees (paged: unpin the
+        slot's blocks). Called under _state_lock; must be idempotent."""
+
+    def _on_retire_locked(self, req: _Request) -> None:
+        """A request finished cleanly ("stop"/"length_cap") and still owns
+        its slot (paged: publish its prefix into the reuse cache). Called
+        under _state_lock just before the slot frees."""
+
+    def _discard_request_locked(self, req: _Request) -> None:
+        """A request is leaving the engine WITHOUT owning a slot (cancelled
+        while queued, or poisoned by a device failure) — drop any resources
+        it holds directly (paged: pre-attached handoff blocks)."""
 
     # -- public single-request surface (back-compat) -------------------------
     def warmup(self) -> None:
@@ -234,6 +312,7 @@ class LLMEngine:
         real_len = int(prompt.shape[0])
         if real_len == 0:
             raise ValueError("empty prompt")
+        _check_token_ids(prompt, self.config.vocab_size, self.name)
         bucket = self._bucket_for(real_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :real_len] = prompt
@@ -313,12 +392,15 @@ class LLMEngine:
                 pass
             if req.slot is not None:
                 self._free_slot_locked(req.slot)
+            else:
+                self._discard_request_locked(req)
             req.done = True
             if req.finish_reason is None:
                 req.finish_reason = "cancelled"
             req.cond.notify_all()
 
     def _free_slot_locked(self, slot: int) -> None:
+        self._release_slot_device(slot)
         r = self._slot_req[slot]
         if r is not None:
             r.slot = None
@@ -330,6 +412,7 @@ class LLMEngine:
         req.finish_reason = reason
         req.done = True
         if req.slot is not None:
+            self._on_retire_locked(req)
             self._free_slot_locked(req.slot)
         req.cond.notify_all()
 
@@ -339,6 +422,8 @@ class LLMEngine:
         with self._state_lock:
             victims = list(self._waiting) + [r for r in self._slot_req
                                              if r is not None]
+            for r in self._waiting:
+                self._discard_request_locked(r)
             self._waiting.clear()
             for slot in range(self.slots):
                 self._free_slot_locked(slot)
@@ -348,7 +433,7 @@ class LLMEngine:
                 if r.finish_reason is None:
                     r.finish_reason = "error"
                 r.cond.notify_all()
-        self._cache, self._last, self._keys = self._sg.init_state()
+        self._reset_device_state()
 
     # -- the iteration-level scheduler ----------------------------------------
     def _step(self) -> None:
@@ -384,8 +469,9 @@ class LLMEngine:
                 if free is None or not self._waiting:
                     break
                 nxt = self._waiting[0]
+                cost = self._admission_cost(nxt)
                 if admitted_tokens and (
-                        admitted_tokens + nxt.bucket > self.prefill_budget):
+                        admitted_tokens + cost > self.prefill_budget):
                     break
                 self._waiting.popleft()
                 if nxt.cancelled:
@@ -397,22 +483,33 @@ class LLMEngine:
                 self._greedy[free] = nxt.temperature <= 0
                 self._temps[free] = nxt.temperature if nxt.temperature > 0 else 0.0
             t_admit = time.perf_counter()
+            try:
+                self._dispatch_prefill(nxt, free)
+            except NoFreeBlocks:
+                # Paged pool exhausted even after cache eviction: put the
+                # request back at the head and stop admitting — in-flight
+                # retires free blocks, and the first admission of a step is
+                # exempt from the budget so progress is guaranteed once
+                # blocks return.
+                with self._state_lock:
+                    self._free_slot_locked(free)
+                    if not nxt.cancelled:
+                        self._waiting.appendleft(nxt)
+                break
+            nxt.queued_s = t_admit - nxt.submitted_at
+            nxt.prefill_s = time.perf_counter() - t_admit
             if nxt.trace_ctx is not None:
                 tracing.emit(
                     "llm.admission_wait", nxt.trace_ctx,
-                    duration=t_admit - nxt.submitted_at,
+                    duration=nxt.queued_s,
                     attrs={"slot": free, "engine": self.name})
-            pf = self._sg.prefill_fn(nxt.bucket)
-            self._cache, self._last, self._keys = pf(
-                self.params, self._cache, self._last, self._keys,
-                nxt.padded, nxt.real_len, free, nxt.seed)
-            if nxt.trace_ctx is not None:
                 tracing.emit(
                     "llm.prefill", nxt.trace_ctx,
-                    duration=time.perf_counter() - t_admit,
+                    duration=nxt.prefill_s,
                     attrs={"slot": free, "bucket": nxt.bucket,
-                           "prompt_len": nxt.real_len})
-            admitted_tokens += nxt.bucket
+                           "prompt_len": nxt.real_len,
+                           "hit_tokens": nxt.hit_tokens})
+            admitted_tokens += cost
 
         with self._state_lock:
             if not any(r is not None for r in self._slot_req):
@@ -420,20 +517,18 @@ class LLMEngine:
             active = self._active.copy()
             greedy = self._greedy.copy()
             temps = self._temps.copy()
+            extra = self._decode_operands_locked()
 
         # 3. One batched decode chunk advancing every active slot.
-        df = self._sg.decode_fn(self.chunk)
         t0 = time.perf_counter()
-        toks, self._cache, self._last, self._keys = df(
-            self.params, self._cache, self._last, self._keys,
-            active, greedy, temps)
+        toks = self._run_decode(active, greedy, temps, extra)
         host_toks = np.asarray(toks)  # the step's single device sync
         dt = time.perf_counter() - t0
         now = time.perf_counter()
 
         # 4. Distribute each slot's tokens to its request.
         delivered_total = 0
-        ttfts: List[float] = []
+        ttfts: List[tuple] = []  # (total, queued, prefill) per first token
         batch_size = int(active.sum())
         chunk_spans: List[tuple] = []  # sampled requests' (ctx, slot, ntok)
         with self._state_lock:
@@ -448,10 +543,12 @@ class LLMEngine:
                 upto = min(self.chunk, req.max_new - req.emitted)
                 if upto > 0 and req.ttft_s is None:
                     req.ttft_s = now - req.submitted_at
-                    ttfts.append(req.ttft_s)
+                    ttfts.append((req.ttft_s, req.queued_s, req.prefill_s))
                 if req.trace_ctx is not None and upto > 0:
                     chunk_spans.append((req.trace_ctx, slot, upto))
-                req.tokens.extend(int(t) for t in host_toks[slot][:upto])
+                new_toks = [int(t) for t in host_toks[slot][:upto]]
+                req.tokens.extend(new_toks)
+                req.out_ids.extend(new_toks)
                 req.emitted += upto
                 req.decode_tokens += upto
                 req.decode_seconds += dt
@@ -470,7 +567,7 @@ class LLMEngine:
                                 "batch": batch_size})
         self._observe(delivered_total, ttfts)
 
-    def _observe(self, delivered: int, ttfts: List[float]) -> None:
+    def _observe(self, delivered: int, ttfts: List[tuple]) -> None:
         from ray_tpu.core.metrics_export import (metrics_enabled,
                                                  serve_tokens_total,
                                                  serve_ttft_hist)
@@ -480,8 +577,15 @@ class LLMEngine:
         tags = {"deployment": self.name}
         if delivered:
             serve_tokens_total().inc(delivered, tags)
-        for t in ttfts:
-            serve_ttft_hist().observe(t, tags)
+        hist = serve_ttft_hist()
+        for total, queued, prefill in ttfts:
+            # Phase split: queued (submit→admission), prefill (the prefill
+            # dispatch), decode (the remainder — first chunk + distribution).
+            hist.observe(total, {**tags, "phase": "total"})
+            hist.observe(queued, {**tags, "phase": "queued"})
+            hist.observe(prefill, {**tags, "phase": "prefill"})
+            hist.observe(max(0.0, total - queued - prefill),
+                         {**tags, "phase": "decode"})
 
     # -- introspection --------------------------------------------------------
     def stats(self) -> Dict[str, float]:
@@ -557,6 +661,764 @@ class LLMEngine:
         }
 
 
+class PagedLLMEngine(LLMEngine):
+    """Continuous-batching engine over a PAGED KV cache with prefix reuse.
+
+    Same scheduler and streaming contract as :class:`LLMEngine`; the device
+    half is a shared pool of ``serve_kv_block_tokens``-sized KV blocks
+    (:class:`~ray_tpu.models.generate.PagedGenerator`) addressed through
+    per-slot block tables, with a host-side :class:`~ray_tpu.models.generate.
+    KVBlockManager` doing refcounts and hash-based prefix reuse:
+
+    - admission looks the prompt up in the block-hash table and prefills
+      ONLY the uncached suffix (``start_pos = hit_len``) — a shared system
+      prompt or multi-turn history costs its prefill FLOPs once;
+    - a hit on a retired sequence's partial tail block is copy-on-write:
+      the block is duplicated into a private block before the divergent
+      suffix writes into it, full-block hits share by refcount alone;
+    - at retire the finished prompt+output chain is registered so the NEXT
+      turn of the conversation hits it;
+    - pool exhaustion (after LRU-evicting unpinned cached blocks) requeues
+      the request rather than failing it.
+    """
+
+    def __init__(self, params, config: TransformerConfig, *,
+                 block_tokens: Optional[int] = None,
+                 pool_blocks: Optional[int] = None, **kw):
+        from ray_tpu.core.config import config as _get_config
+
+        knobs = _get_config()
+        self.block_tokens = int(block_tokens if block_tokens is not None
+                                else knobs.serve_kv_block_tokens)
+        self._pool_blocks_cfg = int(pool_blocks if pool_blocks is not None
+                                    else knobs.serve_kv_pool_blocks)
+        super().__init__(params, config, **kw)
+
+    # -- device-half hooks ----------------------------------------------------
+    def _init_device(self) -> None:
+        self.blocks_per_seq = -(-self.max_len // self.block_tokens)
+        # Auto pool size: 2x a full slot set plus the trash block — half the
+        # pool can idle as reusable prefix cache under full load.
+        num_blocks = self._pool_blocks_cfg or (
+            2 * self.slots * self.blocks_per_seq + 1)
+        self._pg = PagedGenerator(self.params, self.config, slots=self.slots,
+                                  num_blocks=num_blocks,
+                                  block_tokens=self.block_tokens,
+                                  max_len=self.max_len)
+        self.kv = KVBlockManager(num_blocks, self.block_tokens)
+        (self._k_pool, self._v_pool,
+         self._last, self._keys) = self._pg.init_state()
+        self._slot_table = np.zeros((self.slots, self.blocks_per_seq),
+                                    np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(self.slots)]
+        self._hit_pending = 0  # hit tokens awaiting metric flush (step thread)
+
+    def _reset_device_state(self) -> None:
+        (self._k_pool, self._v_pool,
+         self._last, self._keys) = self._pg.init_state()
+        # Pool contents are gone — the prefix cache resets with it.
+        self.kv = KVBlockManager(self.kv.num_blocks, self.block_tokens)
+        self._slot_table[:] = 0
+        self._slot_blocks = [[] for _ in range(self.slots)]
+
+    def warmup(self) -> None:
+        with self._step_lock:
+            zero_row = np.zeros(self.blocks_per_seq, np.int32)  # all trash
+            for b in self.buckets:
+                pf = self._pg.prefill_fn(b)
+                (self._k_pool, self._v_pool, self._last, self._keys) = pf(
+                    self.params, self._k_pool, self._v_pool, self._last,
+                    self._keys, zero_row, np.zeros((1, b), np.int32),
+                    0, b, 0, 0)
+            df = self._pg.decode_fn(self.chunk)
+            toks, self._k_pool, self._v_pool, self._last, self._keys = df(
+                self.params, self._k_pool, self._v_pool, self._last,
+                self._keys, np.zeros((self.slots, self.blocks_per_seq),
+                                     np.int32),
+                np.zeros(self.slots, np.int32), np.zeros(self.slots, bool),
+                self._greedy, self._temps)
+            np.asarray(toks)
+            cf = self._pg.copy_fn()
+            self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool, 0, 0)
+            self._reset_device_state()
+
+    def _suffix_bucket(self, n: int) -> int:
+        # The suffix prefill's compile bucket — unlike _bucket_for it needs
+        # no decode-chunk headroom check (submit already validated the full
+        # prompt against max_len).
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _admission_cost(self, req: _Request) -> int:
+        if req.preloaded is not None:
+            return 0  # prefill already paid on the prefill-side engine
+        hit = self.kv.peek_hit_len([int(t) for t in req.prompt])
+        return self._suffix_bucket(max(1, req.real_len - hit))
+
+    def _dispatch_prefill(self, req: _Request, slot: int) -> None:
+        bt = self.block_tokens
+        if req.preloaded is not None:
+            self._attach_preloaded(req, slot)
+            return
+        tokens = [int(t) for t in req.prompt]
+        full, tail, hit_len = self.kv.lookup(tokens)
+        try:
+            # The table must cover every position this sequence can ever
+            # write: the prompt plus whole decode chunks until max_new is
+            # reached (decode always writes full chunks; the finishing
+            # chunk's spill past max_new still lands in the pool).
+            n_chunks = -(-req.max_new // self.chunk)
+            max_written = min(self.max_len,
+                              req.real_len + n_chunks * self.chunk)
+            need = -(-max_written // bt)
+            # Full-block hits are shared in place; a tail hit contributes
+            # CONTENT only (its copy-on-write destination is a fresh block),
+            # so allocation covers everything beyond the full hits.
+            fresh = self.kv.alloc(need - len(full))
+        except NoFreeBlocks:
+            self.kv.release(full + ([tail] if tail is not None else []))
+            raise
+        ids = list(full)
+        if tail is not None:
+            dst = fresh.pop(0)
+            cf = self._pg.copy_fn()
+            self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool,
+                                            int(tail), int(dst))
+            self.kv.note_cow()
+            self.kv.release([tail])  # pin the private copy, not the original
+            ids.append(dst)
+        ids.extend(fresh)
+        row = np.zeros(self.blocks_per_seq, np.int32)
+        row[:len(ids)] = ids
+        req.hit_tokens = hit_len
+        req.bucket = self._suffix_bucket(req.real_len - hit_len)
+
+        suffix_len = req.real_len - hit_len
+        padded = np.zeros((1, req.bucket), np.int32)
+        padded[0, :suffix_len] = req.prompt[hit_len:]
+        pf = self._pg.prefill_fn(req.bucket)
+        (self._k_pool, self._v_pool, self._last, self._keys) = pf(
+            self.params, self._k_pool, self._v_pool, self._last, self._keys,
+            row, padded, hit_len, suffix_len, slot, req.seed)
+        # Commit ATOMICALLY with the cancel path: this runs outside
+        # _state_lock, so a concurrent _cancel may have freed the slot
+        # mid-dispatch. Attaching first and registering later would let
+        # _release_slot_device free blocks the prefix table still points
+        # at; attaching after a lost cancel would leak the pins forever.
+        # Publishing the prompt's FULL blocks here (their content is final —
+        # decode writes only at positions >= real_len) lets a concurrent
+        # request with the same prefix hit while this one still decodes.
+        n_full_prompt = (req.real_len // bt) * bt
+        with self._state_lock:
+            if self._slot_req[slot] is not req or req.cancelled:
+                self.kv.release(ids)  # slot lost mid-dispatch — drop the pins
+                return
+            self._slot_table[slot, :] = row
+            self._slot_blocks[slot] = ids
+            if n_full_prompt:
+                self.kv.register_chain(tokens, ids, n_full_prompt)
+            self._hit_pending += hit_len
+
+    def _attach_preloaded(self, req: _Request, slot: int) -> None:
+        """Disaggregation handoff: the prompt's K/V blocks were already
+        uploaded into the pool by ``admit_prefilled`` — attach the table row
+        and seed the slot's logits/PRNG rows from the handed-off state."""
+        ids = list(req.blocks)
+        row = np.zeros(self.blocks_per_seq, np.int32)
+        row[:len(ids)] = ids
+        sl = self._pg.set_last_fn()
+        self._last, self._keys = sl(self._last, self._keys,
+                                    np.asarray(req.preloaded, np.float32),
+                                    slot, req.seed)
+        # Same atomic commit as _dispatch_prefill: a cancel that freed the
+        # slot mid-attach found _slot_blocks[slot] empty (and, with req.slot
+        # set, never took the _discard_request_locked path), so the handoff
+        # pins are ours to drop here.
+        with self._state_lock:
+            req.blocks = []
+            if self._slot_req[slot] is not req or req.cancelled:
+                self.kv.release(ids)
+                return
+            self._slot_table[slot, :] = row
+            self._slot_blocks[slot] = ids
+            self._hit_pending += req.hit_tokens
+
+    def _decode_operands_locked(self):
+        return (self._slot_table.copy(),
+                np.asarray(self._slot_len, np.int32))
+
+    def _run_decode(self, active, greedy, temps, extra):
+        tables, lengths = extra
+        df = self._pg.decode_fn(self.chunk)
+        (toks, self._k_pool, self._v_pool,
+         self._last, self._keys) = df(
+            self.params, self._k_pool, self._v_pool, self._last, self._keys,
+            tables, lengths, active, greedy, temps)
+        return toks
+
+    def _release_slot_device(self, slot: int) -> None:
+        ids = self._slot_blocks[slot]
+        if ids:
+            self._slot_blocks[slot] = []
+            self._slot_table[slot, :] = 0
+            self.kv.release(ids)
+
+    def _on_retire_locked(self, req: _Request) -> None:
+        ids = self._slot_blocks[req.slot] if req.slot is not None else []
+        if not ids:
+            return
+        # Register the finished prompt+output chain (including a partial
+        # tail entry) — the conversation's next turn extends exactly this
+        # token sequence. Tokens past `emitted` (final-chunk spill) were
+        # written to the pool but are NOT part of the chain, and
+        # register_chain only publishes blocks fully covered by n_real.
+        chain = [int(t) for t in req.prompt] + req.out_ids[:req.emitted]
+        self.kv.register_chain(chain, ids,
+                               min(len(chain), len(ids) * self.block_tokens))
+
+    def _discard_request_locked(self, req: _Request) -> None:
+        ids, req.blocks = req.blocks, []
+        if ids:
+            self.kv.release(ids)
+
+    # -- disaggregation halves ------------------------------------------------
+    def prefill_to_blocks(self, prompt_ids: Sequence[int], *, seed: int = 0):
+        """Prefill-side half of disaggregated serving: run (suffix-)prefill
+        for ``prompt_ids`` into pool blocks and return host copies for the
+        handoff lane — ``(k [L,nb,bt,H,Dh], v, last_row [V], hit_tokens)``.
+
+        The chain (full blocks AND partial tail — nothing will extend these
+        blocks here) is registered in the LOCAL prefix cache before the pins
+        drop, so a same-prefix prompt later only prefills its suffix even
+        on the prefill side. Uses slot 0 under the step lock; a prefill
+        engine serves no decode traffic, so the slot is exclusive.
+        """
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        real_len = int(prompt.shape[0])
+        if real_len == 0:
+            raise ValueError("empty prompt")
+        bt = self.block_tokens
+        tokens = [int(t) for t in prompt]
+        with self._step_lock:
+            full, tail, hit_len = self.kv.lookup(tokens)
+            try:
+                need = -(-real_len // bt)
+                fresh = self.kv.alloc(need - len(full))
+            except NoFreeBlocks:
+                self.kv.release(full + ([tail] if tail is not None else []))
+                raise
+            ids = list(full)
+            if tail is not None:
+                dst = fresh.pop(0)
+                cf = self._pg.copy_fn()
+                self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool,
+                                                int(tail), int(dst))
+                self.kv.note_cow()
+                self.kv.release([tail])
+                ids.append(dst)
+            ids.extend(fresh)
+            row = np.zeros(self.blocks_per_seq, np.int32)
+            row[:len(ids)] = ids
+            suffix_len = real_len - hit_len
+            bucket = self._suffix_bucket(suffix_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :suffix_len] = prompt[hit_len:]
+            pf = self._pg.prefill_fn(bucket)
+            (self._k_pool, self._v_pool, self._last, self._keys) = pf(
+                self.params, self._k_pool, self._v_pool, self._last,
+                self._keys, row, padded, hit_len, suffix_len, 0, seed)
+            ef = self._pg.extract_fn(len(ids))
+            k, v = ef(self._k_pool, self._v_pool, np.asarray(ids, np.int32))
+            k = np.asarray(k)
+            v = np.asarray(v)
+            last_row = np.asarray(self._last[0])
+            self.kv.register_chain(tokens, ids, real_len)
+            self.kv.release(ids)
+        return k, v, last_row, hit_len
+
+    def admit_prefilled(self, prompt_ids: Sequence[int],
+                        k: np.ndarray, v: np.ndarray, last_row: np.ndarray,
+                        *, max_new_tokens: int = 32, temperature: float = 0.0,
+                        seed: int = 0, hit_tokens: int = 0,
+                        submitted_at: Optional[float] = None,
+                        trace_ctx=None, timeout_s: float = 30.0) -> _Request:
+        """Decode-side half of disaggregated serving: upload handed-off KV
+        blocks into the pool and enqueue a decode-only request (admission
+        attaches the table row instead of prefilling). Blocks — briefly —
+        until the pool can supply the sequence's block budget.
+
+        The upload is synchronous (``block_until_ready``): on return the
+        caller may release the shm views ``k``/``v`` point into.
+        """
+        import jax
+
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        real_len = int(prompt.shape[0])
+        if real_len == 0:
+            raise ValueError("empty prompt")
+        bucket = self._bucket_for(real_len)  # validates decode headroom
+        req = _Request(prompt, None, real_len, bucket, int(max_new_tokens),
+                       float(temperature), int(seed),
+                       threading.Condition(self._state_lock))
+        req.trace_ctx = trace_ctx
+        if submitted_at is not None:
+            req.submitted_at = submitted_at
+        if max_new_tokens <= 0:
+            req.done = True
+            req.finish_reason = "stop"
+            return req
+        nb_in = int(k.shape[1])
+        n_chunks = -(-req.max_new // self.chunk)
+        max_written = min(self.max_len, real_len + n_chunks * self.chunk)
+        need = max(-(-max_written // self.block_tokens), nb_in)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                ids = self.kv.alloc(need)
+                break
+            except NoFreeBlocks:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.002)  # in-flight retires free blocks
+        with self._step_lock:
+            inf = self._pg.insert_fn(nb_in)
+            self._k_pool, self._v_pool = inf(
+                self._k_pool, self._v_pool, np.asarray(k), np.asarray(v),
+                np.asarray(ids[:nb_in], np.int32))
+            jax.block_until_ready(self._k_pool)
+        # Publish the prompt's full blocks for LOCAL hits too — a colocated
+        # follow-up (or affinity-routed repeat) skips the handoff entirely.
+        tokens = [int(t) for t in prompt]
+        n_full = (real_len // self.block_tokens) * self.block_tokens
+        if n_full:
+            self.kv.register_chain(tokens, ids, n_full)
+        req.blocks = ids
+        req.preloaded = np.asarray(last_row, np.float32)
+        req.hit_tokens = int(hit_tokens)
+        with self._state_lock:
+            self._waiting.append(req)
+        return req
+
+    # -- introspection --------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        out = super().stats()
+        out.update(self.kv.stats())
+        return out
+
+    def _observe(self, delivered: int, ttfts: List[tuple]) -> None:
+        super()._observe(delivered, ttfts)
+        hits, self._hit_pending = self._hit_pending, 0
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 serve_kv_block_occupancy,
+                                                 serve_kv_hit_tokens_total)
+
+        if not metrics_enabled():
+            return
+        tags = {"deployment": self.name}
+        if hits:
+            serve_kv_hit_tokens_total().inc(hits, tags)
+        st = self.kv.stats()
+        gauge = serve_kv_block_occupancy()
+        for state in ("active", "cached", "free"):
+            gauge.set(st[f"kv_blocks_{state}"], {**tags, "state": state})
+
+    def device_metrics(self, *, prompt_len: int = 16, reps: int = 10) -> Dict:
+        import jax
+
+        bucket = self._suffix_bucket(prompt_len)
+        bps = self.blocks_per_seq
+        with self._step_lock:
+            pf = self._pg.prefill_fn(bucket)
+            df = self._pg.decode_fn(self.chunk)
+            padded = np.zeros((1, bucket), np.int32)
+            row = np.arange(1, bps + 1, dtype=np.int32)
+            tables = np.zeros((self.slots, bps), np.int32)
+            tables[0] = row
+            lengths = np.zeros(self.slots, np.int32)
+            lengths[0] = prompt_len
+            active = np.zeros(self.slots, bool)
+            active[0] = True
+            greedy = np.ones(self.slots, bool)
+            temps = np.zeros(self.slots, np.float32)
+
+            kp, vp, last, keys = self._pg.init_state()  # throwaway pool
+            kp, vp, last, keys = pf(self.params, kp, vp, last, keys, row,
+                                    padded, 0, prompt_len, 0, 0)
+            toks, kp, vp, last, keys = df(self.params, kp, vp, last, keys,
+                                          tables, lengths, active, greedy,
+                                          temps)
+            np.asarray(toks)
+
+            outs = []
+            t0 = time.perf_counter()
+            for i in range(reps):
+                kp, vp, last, keys = pf(self.params, kp, vp, last, keys,
+                                        row, padded, 0, prompt_len, 0, i)
+                toks, kp, vp, last, keys = df(self.params, kp, vp, last,
+                                              keys, tables, lengths, active,
+                                              greedy, temps)
+                outs.append(toks)
+            jax.block_until_ready(outs)
+            ttft_ms = (time.perf_counter() - t0) / reps * 1e3
+
+            n_chunks = (self.max_len - prompt_len) // self.chunk - 1
+            if n_chunks < 1:
+                return {"device_ttft_ms": round(ttft_ms, 2),
+                        "device_decode_tokens_per_sec": 0.0}
+            kp, vp, last, keys = pf(self.params, kp, vp, last, keys, row,
+                                    padded, 0, prompt_len, 0, 0)
+            t0 = time.perf_counter()
+            for _ in range(n_chunks):
+                toks, kp, vp, last, keys = df(self.params, kp, vp, last,
+                                              keys, tables, lengths, active,
+                                              greedy, temps)
+            jax.block_until_ready(toks)
+            dt = time.perf_counter() - t0
+        return {
+            "device_ttft_ms": round(ttft_ms, 2),
+            "device_decode_tokens_per_sec": round(n_chunks * self.chunk / dt,
+                                                  1),
+        }
+
+
+class _DisaggTicket:
+    """One request's place in the disaggregated pipeline: queued → prefill
+    → lane → decode-engine ``_Request``. Resolution (req or error) is
+    signalled through the engine's condition variable."""
+
+    __slots__ = ("prompt", "max_new", "temperature", "seed", "req", "error",
+                 "resolved", "cancelled", "trace_ctx", "submitted_at")
+
+    def __init__(self, prompt, max_new, temperature, seed):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.seed = seed
+        self.req: Optional[_Request] = None
+        self.error: Optional[BaseException] = None
+        self.resolved = False
+        self.cancelled = False
+        self.submitted_at = time.perf_counter()
+        self.trace_ctx = (tracing.current_context()
+                          if tracing.is_sampled() else None)
+
+
+class DisaggregatedLLMEngine:
+    """Prefill/decode disaggregation: a prefill-specialized
+    :class:`PagedLLMEngine` feeding a decode-specialized one over a
+    :class:`~ray_tpu.serve.dag_pipeline.KVHandoffLane`.
+
+    Mixed prefill+decode in one engine serializes heterogeneous work — a
+    long prompt's prefill dispatch stalls every in-flight decode chunk
+    behind it (the scaling cliff the TPU concurrency-limits paper maps).
+    Here decode NEVER runs a prompt prefill: a prefill worker turns prompts
+    into KV blocks (with its own prefix cache, so shared prefixes cost
+    their FLOPs once), ships them over the lane's deferred-ack shm ring,
+    and an ingest worker uploads them into the decode pool (donated
+    ``insert_fn``) and enqueues a decode-only request. Streaming contract,
+    shedding, and stats match :class:`LLMEngine`; ``close()`` joins the
+    workers and destroys the lane (leak-check clean).
+
+    In-process both halves share this object; the same lane protocol works
+    cross-process (attach by name, ``create=False``) when prefill and
+    decode live in separate replicas.
+    """
+
+    def __init__(self, params, config: TransformerConfig, *,
+                 max_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 chunk: int = 8, slots: Optional[int] = None,
+                 max_queue: Optional[int] = None, name: str = "LLM",
+                 prefill_slots: int = 1,
+                 block_tokens: Optional[int] = None,
+                 pool_blocks: Optional[int] = None,
+                 lane_slots: int = 4):
+        from ray_tpu.core.config import config as _get_config
+        from ray_tpu.serve.dag_pipeline import KVHandoffLane
+
+        knobs = _get_config()
+        self.name = name
+        self.chunk = chunk
+        self.max_queue = int(max_queue if max_queue is not None
+                             else knobs.serve_admission_queue_limit)
+        self.decode = PagedLLMEngine(
+            params, config, max_len=max_len, prompt_buckets=prompt_buckets,
+            chunk=chunk, slots=slots, max_queue=0, name=name,
+            block_tokens=block_tokens, pool_blocks=pool_blocks)
+        self.prefill = PagedLLMEngine(
+            params, config, max_len=max_len, prompt_buckets=prompt_buckets,
+            chunk=chunk, slots=max(1, prefill_slots), max_queue=0,
+            name=f"{name}-prefill", block_tokens=block_tokens,
+            pool_blocks=pool_blocks)
+        self.slots = self.decode.slots
+        self.finish_reason = "stop"  # single-stream convenience, as LLMEngine
+
+        c = config
+        bt = self.decode.block_tokens
+        itm = np.dtype(c.dtype).itemsize
+        block_bytes = c.n_layers * bt * c.n_heads * c.head_dim * itm
+        cap = (2 * self.decode.blocks_per_seq * block_bytes
+               + self.decode._pg.logits_dim * 4 + 65536)
+        self.lane = KVHandoffLane(capacity=cap, slots=max(2, lane_slots))
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pq: collections.deque = collections.deque()
+        self._lane_fifo: collections.deque = collections.deque()
+        self._closed = False
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_loop, name=f"{name}-disagg-prefill",
+            daemon=True)
+        self._ingest_thread = threading.Thread(
+            target=self._ingest_loop, name=f"{name}-disagg-ingest",
+            daemon=True)
+        self._prefill_thread.start()
+        self._ingest_thread.start()
+
+    # -- pipeline workers -----------------------------------------------------
+    def _prefill_loop(self) -> None:
+        from ray_tpu.dag.channel import ChannelTimeout
+
+        while True:
+            with self._cv:
+                while not self._pq and not self._closed:
+                    self._cv.wait(timeout=0.1)
+                if self._closed:
+                    return
+                t = self._pq.popleft()
+            if t.cancelled:
+                self._resolve(t, error=None)
+                continue
+            try:
+                k, v, last_row, hit = self.prefill.prefill_to_blocks(
+                    t.prompt, seed=t.seed)
+                meta = {"prompt": t.prompt, "max_new": t.max_new,
+                        "temperature": t.temperature, "seed": t.seed,
+                        "hit_tokens": hit, "last_row": last_row,
+                        "submitted_at": t.submitted_at}
+                with self._cv:
+                    self._lane_fifo.append(t)
+                while True:
+                    try:
+                        self.lane.send(meta, k, v, timeout=1.0)
+                        break
+                    except ChannelTimeout:  # decode side slow to drain
+                        if self._closed:
+                            with self._cv:
+                                try:
+                                    self._lane_fifo.remove(t)
+                                except ValueError:
+                                    pass
+                            self._resolve(
+                                t, error=RuntimeError("engine closed"))
+                            break
+            except BaseException as e:  # noqa: BLE001 — poison one request
+                # The ticket may already sit in _lane_fifo (send can fail
+                # AFTER the append — channel fault, oversized payload);
+                # leaving it there would pair every later handoff with the
+                # wrong ticket. Unqueue before resolving.
+                with self._cv:
+                    try:
+                        self._lane_fifo.remove(t)
+                    except ValueError:
+                        pass
+                self._resolve(t, error=e)
+
+    def _ingest_loop(self) -> None:
+        from ray_tpu.dag.channel import ChannelClosed, ChannelTimeout
+
+        while True:
+            try:
+                meta, k, v, token = self.lane.recv(timeout=0.25)
+            except ChannelTimeout:
+                if self._closed:
+                    return
+                continue
+            except ChannelClosed:
+                return
+            with self._cv:
+                t = self._lane_fifo.popleft() if self._lane_fifo else None
+            if t is None:
+                # Payload with no waiting ticket (its prefill thread
+                # unqueued itself on a send-path error) — drop it and
+                # return the ring slot.
+                self.lane.ack(token)
+                continue
+            try:
+                req = self.decode.admit_prefilled(
+                    meta["prompt"], k, v, meta["last_row"],
+                    max_new_tokens=meta["max_new"],
+                    temperature=meta["temperature"], seed=meta["seed"],
+                    hit_tokens=meta["hit_tokens"],
+                    submitted_at=meta["submitted_at"],
+                    trace_ctx=t.trace_ctx)
+            except BaseException as e:  # noqa: BLE001 — poison one request
+                self.lane.ack(token)
+                self._resolve(t, error=e)
+                continue
+            # The upload landed (admit_prefilled syncs) — release the ring
+            # slot back to the prefill writer. THE deferred-ack handoff.
+            self.lane.ack(token)
+            self._resolve(t, req=req)
+
+    def _resolve(self, t: _DisaggTicket, req: Optional[_Request] = None,
+                 error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            t.req = req
+            t.error = error
+            t.resolved = True
+            cancelled = t.cancelled
+            self._cv.notify_all()
+        if cancelled and req is not None:
+            self.decode._cancel(req)
+
+    # -- request surface (LLMEngine contract) ---------------------------------
+    def submit(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0) -> _DisaggTicket:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.shape[0] == 0:
+            raise ValueError("empty prompt")
+        _check_token_ids(prompt, self.decode.config.vocab_size, self.name)
+        self.decode._bucket_for(int(prompt.shape[0]))  # validate headroom
+        t = _DisaggTicket(prompt, int(max_new_tokens), float(temperature),
+                          int(seed))
+        if max_new_tokens <= 0:
+            t.resolved = True
+            return t
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"engine {self.name} closed")
+            if self.max_queue and len(self._pq) >= self.max_queue:
+                raise Saturated(
+                    f"engine {self.name}: {len(self._pq)} requests already "
+                    f"waiting for prefill (serve_admission_queue_limit="
+                    f"{self.max_queue})")
+            self._pq.append(t)
+            self._cv.notify_all()
+        return t
+
+    def stream(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0,
+               result: Optional[Dict] = None) -> Iterable[int]:
+        if result is None:
+            result = {}
+        t = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                        temperature=temperature, seed=seed)
+
+        def run():
+            raised = None
+            try:
+                with self._cv:
+                    deadline = time.monotonic() + 120.0
+                    while not t.resolved:
+                        # raylint: ignore[blocking-under-lock] — _cv wraps
+                        # self._lock; wait() releases it.
+                        if not self._cv.wait(timeout=0.2) \
+                                and time.monotonic() > deadline:
+                            raise TimeoutError(
+                                "disaggregated prefill stalled")
+                if t.error is not None:
+                    raise t.error
+                if t.req is None:
+                    return
+                for tok in self.decode.drive(t.req):
+                    result["decode_tps"] = t.req.decode_tps()
+                    yield tok
+            except BaseException as e:
+                raised = e
+                raise
+            finally:
+                if t.req is not None:
+                    fr = t.req.finish_reason or "stop"
+                elif t.error is not None or raised is not None:
+                    # The prefill-stall TimeoutError resolves nothing on the
+                    # ticket — without tracking the raise this path would
+                    # claim a clean "stop" for a generator that blew up.
+                    fr = "error"
+                elif t.cancelled:
+                    fr = "cancelled"
+                else:
+                    fr = "stop"
+                result["finish_reason"] = self.finish_reason = fr
+                if t.req is not None and t.req.ttft_s is not None:
+                    result["ttft_s"] = t.req.ttft_s
+
+        gen = run()
+        weakref.finalize(gen, self._cancel_ticket, t)
+        return gen
+
+    def generate(self, prompt_ids: Sequence[int], **kw) -> List[int]:
+        return list(self.stream(prompt_ids, **kw))
+
+    def _cancel_ticket(self, t: _DisaggTicket) -> None:
+        req = None
+        with self._cv:
+            t.cancelled = True
+            try:
+                self._pq.remove(t)
+                t.resolved = True  # never entered the pipeline
+            except ValueError:
+                req = t.req  # mid-pipeline (worker resolves) or decoding
+            self._cv.notify_all()
+        if req is not None:
+            self.decode._cancel(req)
+
+    # -- engine surface delegates ---------------------------------------------
+    def warmup(self) -> None:
+        self.prefill.warmup()
+        self.decode.warmup()
+
+    def stats(self) -> Dict[str, float]:
+        out = self.decode.stats()
+        with self._cv:
+            out["queue_depth"] += float(len(self._pq)
+                                        + len(self._lane_fifo))
+        pf = self.prefill.kv.stats()
+        out["prefill_kv_hit_tokens"] = pf["kv_hit_tokens"]
+        out["prefill_kv_blocks_cached"] = pf["kv_blocks_cached"]
+        return out
+
+    def decode_tokens_per_sec(self) -> float:
+        return self.decode.decode_tokens_per_sec()
+
+    def device_metrics(self, **kw) -> Dict:
+        return self.decode.device_metrics(**kw)
+
+    def close(self) -> None:
+        """Stop the pipeline workers, poison-pill the lane, destroy it.
+        Pending tickets resolve as errors. Idempotent."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            leftovers = list(self._pq)
+            self._pq.clear()
+            self._cv.notify_all()
+        for t in leftovers:
+            self._resolve(t, error=RuntimeError(f"engine {self.name} closed"))
+        self._prefill_thread.join(timeout=5.0)
+        self.lane.close()  # pill — wakes the ingest loop
+        self._ingest_thread.join(timeout=5.0)
+        if self._ingest_thread.is_alive():
+            # It can be parked in admit_prefilled's alloc retry (bounded by
+            # its timeout_s=30) while holding zero-copy views into the ring
+            # — wait that bound out before touching the mapping.
+            self._ingest_thread.join(timeout=35.0)
+        with self._cv:
+            stranded = list(self._lane_fifo)
+            self._lane_fifo.clear()
+        for t in stranded:
+            self._resolve(t, error=RuntimeError(f"engine {self.name} closed"))
+        if self._ingest_thread.is_alive():
+            # Still wedged: destroy() would unmap shm under the thread's
+            # live views — leak the lane instead and let channel teardown
+            # reclaim it when the views drop.
+            return
+        self.lane.destroy()
+
+
 def llm_deployment(
     config: TransformerConfig,
     params_fn: Callable[[], Dict],
@@ -606,8 +1468,19 @@ def llm_deployment(
     @serve.deployment(name=name, **deployment_kwargs)
     class LLMServer:
         def __init__(self):
-            self.engine = LLMEngine(params_fn(), config, slots=n_slots,
-                                    chunk=chunk, max_queue=q_limit, name=name)
+            # Engine choice re-reads the knobs HERE (replica process): the
+            # paged engine is the default; serve_kv_paged_enabled=0 falls
+            # back to the PR 8 slotted engine, serve_disaggregation_enabled=1
+            # splits prefill from decode over a KV handoff lane.
+            eng_knobs = _get_config()
+            if bool(eng_knobs.serve_disaggregation_enabled):
+                cls = DisaggregatedLLMEngine
+            elif bool(eng_knobs.serve_kv_paged_enabled):
+                cls = PagedLLMEngine
+            else:
+                cls = LLMEngine
+            self.engine = cls(params_fn(), config, slots=n_slots,
+                              chunk=chunk, max_queue=q_limit, name=name)
             self.engine.warmup()
 
         def __call__(self, payload):
