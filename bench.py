@@ -395,6 +395,335 @@ def run_trace_overhead() -> None:
     print(json.dumps({"metric": "trace_overhead", **results}))
 
 
+def run_stub_daemon(gcs_address: str, num_cpus: int) -> None:
+    """Bench stub node daemon (own process): the daemon's lease surface
+    with REAL block accounting (LocalLeaseTable) but fake worker processes
+    — control-plane cost without worker execution. Registers itself,
+    heartbeats, serves until killed."""
+    import threading
+
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.lease_table import LocalLeaseTable, is_block_lease
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    class StubDaemon:
+        def __init__(self):
+            self.table = LocalLeaseTable()
+            self._lock = threading.Lock()
+            self._leases = {}
+            self._n = 0
+
+        def ping(self):
+            return "pong"
+
+        def adopt_capacity_block(self, block_id, shape, total):
+            self.table.adopt(block_id, shape, total)
+
+        def revoke_capacity_block(self, block_id):
+            self.table.revoke(block_id)
+
+        def _fake_worker(self, lease_id):
+            with self._lock:
+                self._n += 1
+                wid = b"bench-worker-%016d" % self._n
+                self._leases[wid] = lease_id
+            return wid
+
+        def lease_worker_block(self, block_id, shape, total):
+            lease = self.table.carve(block_id, shape=shape, total=total)
+            if lease is None:
+                return None
+            return lease, self._fake_worker(lease), "127.0.0.1:9"
+
+        def lease_worker_block_n(self, block_id, shape, total, n):
+            grants = []
+            for _ in range(max(1, int(n))):
+                got = self.lease_worker_block(block_id, shape, total)
+                if got is None:
+                    break
+                grants.append(got)
+            return grants
+
+        def lease_worker(self, lease_id):
+            return self._fake_worker(lease_id), "127.0.0.1:9"
+
+        def return_leased_worker(self, wid):
+            with self._lock:
+                lease = self._leases.pop(wid, None)
+            if lease is not None and is_block_lease(lease):
+                self.table.release(lease)
+
+    stub = StubDaemon()
+    server = RpcServer(stub, max_workers=64, name="bench-daemon")
+    node_id = NodeID.from_random()
+    gcs = RpcClient(gcs_address)
+    gcs.call("register_node", node_id, server.address,
+             {"CPU": float(num_cpus)}, {}, timeout=30.0)
+    print(f"STUB_READY={server.address}", flush=True)
+    while True:
+        time.sleep(1.0)
+        try:
+            gcs.call("heartbeat", node_id, timeout=5.0)
+        except Exception:
+            os._exit(0)  # GCS gone: bench over
+
+
+def run_control_plane_driver(mode: str, tasks: int, threads: int,
+                             gcs_address: str) -> None:
+    """Bench client process: drive ``tasks`` lease cycles from ``threads``
+    threads. mode "baseline" = per-task request_lease + lease_worker +
+    return + release (2 synchronous GCS RPCs per task — the pre-round-8
+    plane). mode "batched" = request_lease_batch covering up to 16 tasks
+    per GCS hop, per-task leases carved at the node daemon."""
+    import threading as _threading
+
+    from ray_tpu.core.rpc import RpcClient
+
+    todo = [tasks]
+    todo_lock = _threading.Lock()
+
+    def claim(n: int) -> int:
+        with todo_lock:
+            take = min(n, todo[0])
+            todo[0] -= take
+            return take
+
+    def unclaim(n: int) -> None:
+        with todo_lock:
+            todo[0] += n
+
+    shape = {"CPU": 1}
+
+    def client_baseline():
+        gcs = RpcClient(gcs_address)
+        daemons = {}
+        try:
+            while claim(1):
+                lease_id, _nid, addr = gcs.call(
+                    "request_lease", shape, None, 60.0, timeout=None)
+                d = daemons.get(addr)
+                if d is None:
+                    d = daemons[addr] = RpcClient(addr)
+                wid, _waddr = d.call("lease_worker", lease_id, timeout=30.0)
+                d.notify("return_leased_worker", wid)
+                gcs.notify("release_lease", lease_id)
+        finally:
+            for d in daemons.values():
+                d.close()
+            gcs.close()
+
+    def client_batched():
+        gcs = RpcClient(gcs_address)
+        daemons = {}
+        try:
+            while True:
+                take = claim(16)
+                if not take:
+                    return
+                block_id, _nid, addr, granted = gcs.call(
+                    "request_lease_batch", shape, None, take, 60.0,
+                    timeout=None)
+                d = daemons.get(addr)
+                if d is None:
+                    d = daemons[addr] = RpcClient(addr)
+                # One carve hop covers the whole grant (lease_worker_block_n
+                # amortizes the daemon RPC like the batch grant amortized
+                # the GCS one).
+                grants = d.call("lease_worker_block_n", block_id, shape,
+                                granted, granted, timeout=30.0)
+                for _lease, wid, _waddr in grants:
+                    d.notify("return_leased_worker", wid)
+                # Zero-TTL sweep stand-in: the real daemon returns idle
+                # capacity on its background sweep — off the task critical
+                # path — so the return rides a notify, not a sync call.
+                gcs.notify("return_block_capacity", block_id, granted)
+                done = len(grants)
+                if take > done:
+                    unclaim(take - done)
+        finally:
+            for d in daemons.values():
+                d.close()
+            gcs.close()
+
+    target = client_batched if mode == "batched" else client_baseline
+    ts = [_threading.Thread(target=target, daemon=True)
+          for _ in range(threads)]
+    # GO handshake: the parent times the drive window only, so interpreter
+    # boot (seconds, on a small box) never skews the A/B ratio.
+    print("DRIVER_READY=1", flush=True)
+    sys.stdin.readline()
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=600)
+    print(json.dumps({"done": tasks - todo[0],
+                      "elapsed_s": time.perf_counter() - t0}), flush=True)
+
+
+def run_control_plane_child(mode: str, tasks: int, clients: int) -> None:
+    """One A/B arm, orchestrated across REAL process boundaries: the actual
+    GCS server process, 4 stub-daemon processes, and 8 client driver
+    processes — so the GCS's capacity (the thing this round shards) is what
+    saturates, not a shared GIL. Flag env (shards/batching/ingest) is set
+    by the parent and inherited by every child."""
+    import threading
+
+    from ray_tpu.core.cluster import _read_tagged_line
+    from ray_tpu.core.rpc import RpcClient
+
+    env = dict(os.environ)
+    procs = []
+    try:
+        gcs_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.gcs_server"],
+            stdout=subprocess.PIPE, env=env)
+        procs.append(gcs_proc)
+        gcs_address = _read_tagged_line(gcs_proc, "GCS_ADDRESS")
+        for _ in range(4):
+            p = subprocess.Popen(
+                [sys.executable, __file__, "--stub-daemon", gcs_address,
+                 "64"], stdout=subprocess.PIPE, env=env)
+            procs.append(p)
+            _read_tagged_line(p, "STUB_READY")
+
+        driver_procs = 8
+        per = [tasks // driver_procs] * driver_procs
+        per[0] += tasks - sum(per)
+        threads = max(1, clients // driver_procs)
+        drivers = [subprocess.Popen(
+            [sys.executable, __file__, "--control-plane-driver", mode,
+             str(n), str(threads), gcs_address],
+            stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True,
+            env=env) for n in per]
+        procs.extend(drivers)
+        for p in drivers:
+            _read_tagged_line(p, "DRIVER_READY")
+        t0 = time.perf_counter()
+        for p in drivers:
+            p.stdin.write("GO\n")
+            p.stdin.flush()
+        done = 0
+        for p in drivers:
+            out, _ = p.communicate(timeout=600)
+            done += json.loads(out.strip().splitlines()[-1])["done"]
+        dt = time.perf_counter() - t0
+
+        # Scenario 2: lease-grant latency while a slow aggregator chews on
+        # a telemetry flood. This needs a monkeypatched store, so it runs
+        # against an in-process service (same env-resolved flags); flood
+        # and grants share one handler pool, as in production.
+        from ray_tpu.core.gcs_server import GcsService
+        from ray_tpu.core.ids import NodeID
+        from ray_tpu.core.rpc import RpcServer
+
+        svc = GcsService()
+        server = RpcServer(svc, max_workers=128, name="bench-gcs-lag")
+        orig_report = svc.store.report_metrics
+        svc.store.report_metrics = (
+            lambda *a, **k: (time.sleep(0.05), orig_report(*a, **k)))
+        svc.register_node(NodeID.from_random(), "127.0.0.1:1",
+                          {"CPU": 64}, {})
+        flood = RpcClient(server.address)
+        probe = RpcClient(server.address)
+        lat = []
+        try:
+            for i in range(200):
+                flood.notify("report_metrics", "bench-node", "comp", i, [])
+            for _ in range(60):
+                t1 = time.perf_counter()
+                lease_id, _nid, _a = probe.call(
+                    "request_lease", {"CPU": 1}, None, 30.0, timeout=60.0)
+                lat.append(time.perf_counter() - t1)
+                probe.notify("release_lease", lease_id)
+            ingest = probe.call("ingest_stats")
+        finally:
+            svc.store.report_metrics = orig_report
+            flood.close()
+            probe.close()
+            server.stop()
+            svc.shutdown()
+        lat.sort()
+        print(json.dumps({
+            "mode": mode,
+            "tasks": tasks,
+            "tasks_done": done,
+            "clients": clients,
+            "lease_cycles_per_s": round(done / dt, 1),
+            "stalled_ingest_lease_p50_ms": round(
+                lat[len(lat) // 2] * 1e3, 2),
+            "stalled_ingest_lease_p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2),
+            "ingest_dropped": ingest["dropped"],
+            "ingest_submitted": ingest["submitted"],
+        }))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def run_control_plane(quick: bool = False) -> None:
+    """Control-plane scaling A/B: the round-8 sharded plane (capacity-block
+    batching + gcs_shards=8 + async ingest) vs the single-lock per-task
+    plane it replaces, recorded in ``BENCH_core_r08.json``. Each arm runs in
+    a fresh interpreter with its flags resolved from env at boot, exactly as
+    a deployed GCS would."""
+    tasks = 600 if quick else 10_000
+    clients = 16 if quick else 64
+
+    def trial(mode: str) -> dict:
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        if mode == "batched":
+            env.update({"RAY_TPU_GCS_SHARDS": "8",
+                        "RAY_TPU_LEASE_BATCH_ENABLED": "1",
+                        "RAY_TPU_GCS_INGEST_ASYNC_ENABLED": "1"})
+        else:
+            env.update({"RAY_TPU_GCS_SHARDS": "1",
+                        "RAY_TPU_LEASE_BATCH_ENABLED": "0",
+                        "RAY_TPU_GCS_INGEST_ASYNC_ENABLED": "0"})
+        r = subprocess.run(
+            [sys.executable, __file__, "--control-plane-child", mode,
+             str(tasks), str(clients)],
+            capture_output=True, text=True, timeout=900, env=env)
+        if r.returncode != 0:
+            print(json.dumps({"metric": "control_plane",
+                              "error": (r.stderr or "")[-400:]}))
+            sys.exit(1)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # Alternating order + medians, the same shared-box protocol as the
+    # observability A/Bs.
+    order = (("batched", "baseline") if quick
+             else ("batched", "baseline", "baseline", "batched",
+                   "batched", "baseline"))
+    trials = {"batched": [], "baseline": []}
+    for mode in order:
+        trials[mode].append(trial(mode))
+
+    def median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    results = {"tasks_in_flight": tasks, "client_threads": clients,
+               "trials_per_mode": len(trials["batched"])}
+    for mode in ("batched", "baseline"):
+        results[f"lease_cycles_per_s_{mode}"] = median(
+            [t["lease_cycles_per_s"] for t in trials[mode]])
+        results[f"stalled_ingest_lease_p99_ms_{mode}"] = median(
+            [t["stalled_ingest_lease_p99_ms"] for t in trials[mode]])
+    results["speedup"] = round(
+        results["lease_cycles_per_s_batched"]
+        / results["lease_cycles_per_s_baseline"], 2)
+    results["meets_2x_target"] = results["speedup"] >= 2.0
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_core_r08.json")
+    with open(out, "w") as f:
+        json.dump({"results": results}, f, indent=1)
+    print(json.dumps({"metric": "control_plane", **results}))
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_bench()
@@ -408,5 +737,18 @@ if __name__ == "__main__":
                         == "1")
     elif "--trace-overhead" in sys.argv:
         run_trace_overhead()
+    elif "--stub-daemon" in sys.argv:
+        i = sys.argv.index("--stub-daemon")
+        run_stub_daemon(sys.argv[i + 1], int(sys.argv[i + 2]))
+    elif "--control-plane-driver" in sys.argv:
+        i = sys.argv.index("--control-plane-driver")
+        run_control_plane_driver(sys.argv[i + 1], int(sys.argv[i + 2]),
+                                 int(sys.argv[i + 3]), sys.argv[i + 4])
+    elif "--control-plane-child" in sys.argv:
+        i = sys.argv.index("--control-plane-child")
+        run_control_plane_child(sys.argv[i + 1], int(sys.argv[i + 2]),
+                                int(sys.argv[i + 3]))
+    elif "--control-plane" in sys.argv:
+        run_control_plane(quick="--quick" in sys.argv)
     else:
         main()

@@ -267,6 +267,35 @@ class Config:
     # one replica), larger = only near-identical prompts share a replica.
     serve_prefix_affinity_blocks = _Flag(4)
 
+    # -- control plane (sharded GCS + daemon-local leases) ---------------------
+    # Lock domains for the GCS object-location / KV / pubsub tables: state
+    # is hash-partitioned across this many independent shards so location
+    # storms and KV churn stop contending with the scheduling lock. 1
+    # reproduces the single-table behavior byte-for-byte.
+    gcs_shards = _Flag(8)
+    # Batched daemon-local lease grants: the client asks the GCS for one
+    # revocable *capacity block* per (resource-shape, locality) key and the
+    # node daemon carves per-task worker leases out of it locally, so a
+    # deep queue costs one GCS hop instead of one per task. 0 restores
+    # per-task request_lease round trips.
+    lease_batch_enabled = _Flag(True)
+    # Max leases requested in one capacity block (the batch amortization
+    # ceiling; partial grants below this are normal).
+    lease_batch_max = _Flag(16)
+    # Threads in the per-CoreWorker lease-requester pool. Bounds the old
+    # one-thread-per-in-flight-request spawn so a 10k-task burst keeps a
+    # small, fixed requester footprint.
+    lease_requester_threads = _Flag(16)
+    # Non-blocking observability ingest: report_metrics / task-event /
+    # trace-span RPCs land in a bounded staging queue drained by a
+    # dedicated GCS ingest thread, so a burst of spans or a slow aggregator
+    # lags (with a drop counter) instead of holding RPC handler threads
+    # against lease grants. 0 applies reports inline as before.
+    gcs_ingest_async_enabled = _Flag(True)
+    # Staging-queue capacity for the async observability ingest; overflow
+    # is dropped (counted in the gcs_ingest_dropped gauge), never blocked on.
+    gcs_ingest_queue_max = _Flag(4096)
+
     # -- metrics / observability ----------------------------------------------
     # Cluster-wide metrics pipeline: every process (gcs_server, node_daemon,
     # worker, driver) runs an exporter thread that snapshots its

@@ -36,6 +36,7 @@ from ray_tpu.core.exceptions import (
 )
 from ray_tpu.core.gcs import ActorInfo, NodeInfo
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu.core.lease_table import is_block_lease
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import (RpcClient, RpcClientPool, RpcConnectionError,
                               RpcRemoteError)
@@ -839,6 +840,9 @@ class CoreWorker:
         self._key_states: Dict[tuple, _KeyState] = {}
         self._key_lock = threading.Lock()
         self._lease_sweeper_started = False
+        # Bounded lease-requester pool (lazy): caps concurrent lease RPCs
+        # at lease_requester_threads instead of one thread per queued task.
+        self._lease_pool: Optional[ThreadPoolExecutor] = None
 
         # Batched owner frees (see _free_object).
         self._free_lock = threading.Lock()
@@ -2144,26 +2148,65 @@ class CoreWorker:
             threading.Thread(target=self._runner,
                              args=(key, state, entry, task),
                              name="task-runner", daemon=True).start()
-        while state.requesting < min(len(state.queue) - covered, 64):
-            state.requesting += 1
-            spec = state.queue[0].spec
-            threading.Thread(
-                target=self._lease_requester, args=(key, state, spec),
-                name="lease-req", daemon=True).start()
+        unclaimed = len(state.queue) - covered
+        if unclaimed <= 0 or self._shutdown:
+            return
+        if self._batched_key(key):
+            # One batched requester covers up to lease_batch_max tasks per
+            # GCS round trip — the spawn bound shrinks accordingly.
+            batch_max = max(1, int(config().lease_batch_max))
+            need = min((unclaimed + batch_max - 1) // batch_max, 64)
+            while state.requesting < need:
+                state.requesting += 1
+                spec = state.queue[0].spec
+                self._lease_pool_submit(self._lease_requester_batched,
+                                        key, state, spec)
+        else:
+            while state.requesting < min(unclaimed, 64):
+                state.requesting += 1
+                spec = state.queue[0].spec
+                self._lease_pool_submit(self._lease_requester,
+                                        key, state, spec)
+
+    @staticmethod
+    def _batched_key(key: tuple) -> bool:
+        """Batch-eligible scheduling keys: plain default-placement shapes.
+        Affinity/PG/spread placement is per-task, so those keys stay on the
+        single-lease path (gcs_shards=1 + lease_batch_enabled=0 reproduces
+        the old transport exactly)."""
+        return key[1][0] == "default" and bool(config().lease_batch_enabled)
+
+    def _lease_pool_submit(self, fn, *args) -> None:
+        """Run a lease requester on the bounded pool (callers in
+        _ensure_capacity_locked hold _key_lock, making the lazy create
+        race-free; requester self-resubmits find the pool already built)."""
+        pool = self._lease_pool
+        if pool is None:
+            pool = self._lease_pool = ThreadPoolExecutor(
+                max_workers=max(1, int(config().lease_requester_threads)),
+                thread_name_prefix="lease-req")
+        try:
+            pool.submit(fn, *args)
+        except RuntimeError:
+            # Pool shut down mid-submit (worker shutdown): the orphaned
+            # ``requesting`` count is moot — nothing dispatches after it.
+            pass
 
     def _lease_requester(self, key: tuple, state: _KeyState,
-                         spec: TaskSpec) -> None:
+                         spec: TaskSpec, pool_failures: int = 0) -> None:
         """Acquire one (GCS lease → daemon worker) pair, then run tasks.
 
         Every exit transition (give up because demand evaporated, convert
         into a runner, park a surplus grant) happens atomically under
         _key_lock with the queue check, so _dispatch can never see a stale
-        ``requesting`` count and strand a queued task."""
+        ``requesting`` count and strand a queued task. Runs on the bounded
+        lease pool: a GCS-side wait (TimeoutError slice) re-submits to the
+        pool tail instead of looping, so one starved shape can't pin every
+        requester slot."""
         entry = None
         first_task = None
         resources = spec.declared_resources()
         strategy = spec.options.scheduling_strategy
-        pool_failures = 0
         while True:
             with self._key_lock:
                 if entry is not None:
@@ -2189,7 +2232,11 @@ class CoreWorker:
                 granted = self._gcs_rpc.call(
                     "request_lease", resources, strategy, 5.0, timeout=None)
             except TimeoutError:
-                continue  # still queued at the GCS; re-check demand
+                # Still queued at the GCS: yield this pool slot and rejoin
+                # at the queue tail so other shapes' requesters can run.
+                self._lease_pool_submit(self._lease_requester,
+                                        key, state, spec, pool_failures)
+                return
             except RpcConnectionError as e:
                 self._abort_request(key, state, TaskError(
                     "lease", f"GCS unreachable: {e}", None))
@@ -2226,7 +2273,95 @@ class CoreWorker:
         if first_task is None:
             self._release_entry(entry)
             return
-        self._runner(key, state, entry, first_task)
+        # Run on a dedicated thread: a runner holds its lease for the whole
+        # task (plus the hot-idle window) — wedging a bounded pool slot that
+        # long would serialize unrelated lease acquisition.
+        threading.Thread(target=self._runner,
+                         args=(key, state, entry, first_task),
+                         name="task-runner", daemon=True).start()
+
+    def _lease_requester_batched(self, key: tuple, state: _KeyState,
+                                 spec: TaskSpec,
+                                 pool_failures: int = 0) -> None:
+        """Acquire a CAPACITY BLOCK covering up to lease_batch_max queued
+        tasks in ONE GCS round trip, then carve per-task leases at the
+        granting node's daemon (local lock, no GCS hop). Any units left
+        uncarved — demand evaporated mid-batch — stay at the daemon and
+        flow back to the GCS via its idle sweep, not per-lease RPCs."""
+        resources = spec.declared_resources()
+        strategy = spec.options.scheduling_strategy
+        batch_max = max(1, int(config().lease_batch_max))
+        while True:
+            with self._key_lock:
+                if self._shutdown or not state.queue or state.idle:
+                    state.requesting -= 1
+                    self._ensure_capacity_locked(key, state)
+                    return
+                want = min(len(state.queue), batch_max)
+            try:
+                block_id, node_id, node_addr, granted = self._gcs_rpc.call(
+                    "request_lease_batch", resources, strategy, want, 5.0,
+                    timeout=None)
+            except TimeoutError:
+                # Still queued at the GCS: yield the pool slot, rejoin at
+                # the tail (see _lease_requester).
+                self._lease_pool_submit(self._lease_requester_batched,
+                                        key, state, spec, pool_failures)
+                return
+            except RpcConnectionError as e:
+                self._abort_request(key, state, TaskError(
+                    "lease", f"GCS unreachable: {e}", None))
+                return
+            except Exception as e:  # noqa: BLE001 — infeasible etc.
+                self._abort_request(key, state, TaskError(
+                    "lease", f"lease request failed: {e}", None))
+                return
+            carved = 0
+            while carved < granted:
+                with self._key_lock:
+                    take = []
+                    while state.queue and carved + len(take) < granted:
+                        take.append(state.queue.popleft())
+                if not take:
+                    break  # leftover units TTL-return at the daemon
+                try:
+                    grants = self._daemons.get(node_addr).call(
+                        "lease_worker_block_n", block_id, dict(resources),
+                        granted, len(take), timeout=None)
+                    if not grants:
+                        raise WorkerDiedError(
+                            f"capacity block {block_id} revoked or "
+                            f"exhausted at {node_addr}")
+                except Exception as e:  # noqa: BLE001 — node death
+                    # post-grant, pool exhaustion, or a revoked block. The
+                    # tasks go back to the queue head; un-carved capacity
+                    # is reclaimed by daemon-death handling or the idle
+                    # sweep — never by the client.
+                    with self._key_lock:
+                        state.queue.extendleft(reversed(take))
+                    pool_failures += 1
+                    if pool_failures >= 4:
+                        self._abort_request(key, state, TaskError(
+                            "lease", f"cannot obtain a worker after "
+                            f"{pool_failures} block grants: {e}", None))
+                        return
+                    time.sleep(0.1)
+                    break  # re-request from the GCS (block may be dead)
+                if len(grants) < len(take):
+                    # Short batch (slow spawn at the daemon): requeue the
+                    # uncovered tail; the next loop pass retries it.
+                    with self._key_lock:
+                        state.queue.extendleft(reversed(take[len(grants):]))
+                for got, task in zip(grants, take):
+                    lease_id, wid, waddr = got
+                    carved += 1
+                    entry = _LeasedWorker(lease_id, node_id, node_addr,
+                                          wid, waddr)
+                    with self._key_lock:
+                        state.runners += 1
+                    threading.Thread(target=self._runner,
+                                     args=(key, state, entry, task),
+                                     name="task-runner", daemon=True).start()
 
     def _abort_request(self, key: tuple, state: _KeyState, error) -> None:
         """Fail everything queued AND decrement ``requesting`` in ONE
@@ -2436,6 +2571,12 @@ class CoreWorker:
                 "return_leased_worker", entry.worker_id)
         except RpcConnectionError:
             pass
+        if is_block_lease(entry.lease_id):
+            # Block-carved unit: the daemon freed it inside
+            # return_leased_worker (local authority); unused capacity flows
+            # back to the GCS via the daemon's idle sweep, not per-lease
+            # release RPCs.
+            return
         try:
             self._gcs_rpc.notify("release_lease", entry.lease_id)
         except RpcConnectionError:
@@ -3243,6 +3384,8 @@ class CoreWorker:
             except RpcConnectionError:
                 pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        if self._lease_pool is not None:
+            self._lease_pool.shutdown(wait=False, cancel_futures=True)
         if self._prefetch_pool is not None:
             self._prefetch_pool.shutdown(wait=False, cancel_futures=True)
         if self._get_pool is not None:

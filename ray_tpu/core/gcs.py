@@ -98,7 +98,24 @@ class GlobalControlStore:
         self.actors: Dict[ActorID, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.jobs: Dict[JobID, JobInfo] = {}
-        self._kv: Dict[str, Dict[str, bytes]] = {}
+        # Internal KV, hash-partitioned by (namespace, key) across
+        # gcs_shards independent lock domains so KV churn (function
+        # exports, serve controller state) stops contending with the table
+        # lock. gcs_shards=1 keeps one shard — identical to the old single
+        # dict under one lock.
+        from ray_tpu.core.gcs_shards import shard_index
+
+        try:
+            from ray_tpu.core.config import config as _config
+
+            n_shards = max(1, int(_config().gcs_shards))
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            n_shards = 1
+        self._kv_route = lambda ns, key: shard_index(
+            f"{ns}\x00{key}", n_shards)
+        self._kv_shards: List[Dict[str, Dict[str, bytes]]] = [
+            {} for _ in range(n_shards)]
+        self._kv_locks = [threading.Lock() for _ in range(n_shards)]
         self._functions: Dict[str, Any] = {}
         self.pubsub = PubSub()
         self._task_events: List[dict] = []
@@ -210,24 +227,54 @@ class GlobalControlStore:
     # -- internal KV (gcs_kv_manager.cc, store_client_kv.cc) -----------------
 
     def kv_put(self, key: str, value: bytes, namespace: str = "default", overwrite: bool = True) -> bool:
-        with self._lock:
-            ns = self._kv.setdefault(namespace, {})
+        i = self._kv_route(namespace, key)
+        with self._kv_locks[i]:
+            ns = self._kv_shards[i].setdefault(namespace, {})
             if not overwrite and key in ns:
                 return False
             ns[key] = value
             return True
 
     def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
-        with self._lock:
-            return self._kv.get(namespace, {}).get(key)
+        i = self._kv_route(namespace, key)
+        with self._kv_locks[i]:
+            return self._kv_shards[i].get(namespace, {}).get(key)
 
     def kv_del(self, key: str, namespace: str = "default") -> bool:
-        with self._lock:
-            return self._kv.get(namespace, {}).pop(key, None) is not None
+        i = self._kv_route(namespace, key)
+        with self._kv_locks[i]:
+            return self._kv_shards[i].get(namespace, {}).pop(key, None) is not None
 
     def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
-        with self._lock:
-            return [k for k in self._kv.get(namespace, {}) if k.startswith(prefix)]
+        out: List[str] = []
+        for i, shard in enumerate(self._kv_shards):
+            with self._kv_locks[i]:
+                out.extend(k for k in shard.get(namespace, {})
+                           if k.startswith(prefix))
+        return out
+
+    def kv_dump(self) -> Dict[str, Dict[str, bytes]]:
+        """Merged ``{namespace: {key: value}}`` view across every shard —
+        the (shard-count-independent) snapshot format."""
+        merged: Dict[str, Dict[str, bytes]] = {}
+        for i, shard in enumerate(self._kv_shards):
+            with self._kv_locks[i]:
+                for ns, kv in shard.items():
+                    merged.setdefault(ns, {}).update(kv)
+        return merged
+
+    def kv_load(self, data: Dict[str, Dict[str, bytes]]) -> None:
+        """Restore a :meth:`kv_dump` blob, re-routing every key to the
+        CURRENT shard count (a restart may change ``gcs_shards``)."""
+        for shard, lock in zip(self._kv_shards, self._kv_locks):
+            with lock:
+                shard.clear()
+        for ns, kv in (data or {}).items():
+            for key, value in kv.items():
+                self.kv_put(key, value, namespace=ns)
+
+    def kv_shard_count(self) -> int:
+        return len(self._kv_shards)
 
     # -- function/code store (gcs_function_manager.h) ------------------------
 

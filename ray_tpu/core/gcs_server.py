@@ -30,7 +30,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import Config, config, set_config
 from ray_tpu.core.gcs import ActorInfo, GlobalControlStore, JobInfo, NodeInfo
+from ray_tpu.core.gcs_shards import ShardedObjectDirectory, ShardedPubSub
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.core.ingest import ObservabilityIngest
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.core.rpc import (
     BoundedSet,
@@ -67,6 +69,23 @@ class _Lease:
         self.client_id = client_id
 
 
+class _CapacityBlock:
+    # A batched lease grant: `total` units of one resource shape reserved on
+    # one node, carved into per-task worker leases by that node's daemon
+    # (lease ids "cap-N#k"). client_id scopes the block to the requesting
+    # client like _Lease — a client death reclaims the un-returned units.
+    __slots__ = ("block_id", "node_id", "shape", "total", "returned",
+                 "client_id")
+
+    def __init__(self, block_id, node_id, shape, total, client_id=""):
+        self.block_id = block_id
+        self.node_id = node_id
+        self.shape = shape  # ResourceSet of ONE unit
+        self.total = total
+        self.returned = 0
+        self.client_id = client_id
+
+
 class _Bundle:
     __slots__ = ("resources", "node_id", "in_use")
 
@@ -95,8 +114,24 @@ class GcsService:
         self.store = GlobalControlStore()
         self.scheduler = ClusterResourceScheduler()
         self._lock = threading.RLock()
+        # _sched_cv parks only PG-lease and PG-creation waiters (small
+        # populations, always woken together); plain lease waiters park on
+        # PER-SHAPE conditions (_shape_conds) so a release of {CPU:1} no
+        # longer wakes every infeasible {TPU:8} requester — the wake-storm
+        # fix. Both share self._lock, so predicates stay race-free.
         self._sched_cv = threading.Condition(self._lock)
-        self._waiting_demands: Dict[int, Dict[str, float]] = {}
+        self._shape_conds: Dict[tuple, threading.Condition] = {}
+        self._shape_waiters: Dict[tuple, int] = {}
+        self._shape_sets: Dict[tuple, ResourceSet] = {}  # cached per shape
+        self._wake_stats = {"wakes": 0, "skips": 0}
+        # Pending-demand snapshot maintained INCREMENTALLY under its own
+        # small lock: the autoscaler poll is an O(n) list copy that never
+        # touches the scheduling lock. _demand_pos maps demand id -> index
+        # in the parallel _demand_list/_demand_ids arrays (swap-pop remove).
+        self._demand_lock = threading.Lock()
+        self._demand_list: List[Dict[str, float]] = []
+        self._demand_ids: List[int] = []
+        self._demand_pos: Dict[int, int] = {}
         self._demand_seq = 0
         self._node_addr: Dict[NodeID, str] = {}
         self._heartbeats: Dict[NodeID, float] = {}
@@ -108,16 +143,16 @@ class GcsService:
         self._dead_clients = BoundedSet()
         self._leases: Dict[str, _Lease] = {}
         self._next_lease = 0
+        # Capacity blocks: batched lease grants carved locally by daemons
+        # (the daemon-local scheduling plane). Keyed "cap-N".
+        self._blocks: Dict[str, _CapacityBlock] = {}
+        self._next_block = 0
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
-        # object directory: object id bytes -> {node_id: size}
-        self._objects: Dict[bytes, Dict[NodeID, int]] = {}
-        # Lineage for object recovery, deduplicated per creating TASK (all of
-        # a task's return ids share the 24-byte TaskID prefix — one pickled
-        # spec serves every return/stream item). FIFO-capped as a backstop.
-        self._lineage: Dict[bytes, bytes] = {}  # task_id bytes -> spec bytes
-        self._lineage_cap = 10_000
-        # task_id bytes -> live object ids, to GC lineage with its objects
-        self._task_objects: Dict[bytes, set] = {}
+        # Object directory (locations + lineage + per-task live sets),
+        # hash-partitioned by creating-task key across gcs_shards lock
+        # domains so location storms stop contending with scheduling.
+        n_shards = max(1, int(config().gcs_shards))
+        self._directory = ShardedObjectDirectory(n_shards)
         # actor bookkeeping for restart: actor id -> pickled creation spec
         self._actor_specs: Dict[ActorID, bytes] = {}
         self._actor_addr: Dict[ActorID, str] = {}
@@ -125,16 +160,19 @@ class GcsService:
         self._actor_cv = threading.Condition(self._lock)
         self._daemons = RpcClientPool()
         # pubsub as an append-only log per channel, served by long-poll.
-        # Wait lists are PER CHANNEL (a publish wakes only that channel's
-        # parked polls, not every subscriber on one condvar), and filtered
+        # Channels are hash-partitioned across gcs_shards lock domains;
+        # within a shard, wait lists are PER CHANNEL and filtered
         # object-location subscribes additionally park on PER-OID wait
         # lists so a seal wakes only the polls subscribed to that oid.
-        self._pub_lock = threading.Lock()
-        self._pub_conds: Dict[str, threading.Condition] = {}
-        self._pub_log: Dict[str, List[Any]] = {}
-        self._pub_base: Dict[str, int] = {}  # messages truncated off the front
-        # oid bytes -> conditions of filtered subscribes parked on it
-        self._loc_waitlists: Dict[bytes, List[threading.Condition]] = {}
+        self._pubsub = ShardedPubSub(n_shards)
+        # Non-blocking observability ingest: report_metrics / task events /
+        # span batches stage in a bounded queue drained by one dedicated
+        # thread, so a slow aggregator lags instead of parking RPC handler
+        # threads against lease grants. None = inline (legacy) applies.
+        self._ingest: Optional[ObservabilityIngest] = (
+            ObservabilityIngest(self._ingest_apply,
+                                config().gcs_ingest_queue_max)
+            if config().gcs_ingest_async_enabled else None)
         self._snapshot_path = snapshot_path
         self._snapshot_seq = 0
         self._stopped = threading.Event()
@@ -207,7 +245,7 @@ class GcsService:
                 self._actor_specs[actor_id] = spec_bytes
                 self._actor_addr[actor_id] = worker_addr
                 self._actor_cv.notify_all()
-            self._sched_cv.notify_all()
+            self._wake_all_locked()
         self._publish("node", ("ALIVE", node_id.hex(), address))
         self._reschedule_placement_groups()
         if getattr(self, "_pending_detached", None):
@@ -258,11 +296,13 @@ class GcsService:
             # Leases on the node die with it.
             for lease_id in [l for l, v in self._leases.items() if v.node_id == node_id]:
                 self._leases.pop(lease_id)
+            # Capacity blocks too — their resources were dropped with the
+            # node (remove_node), so no release; just forget the records.
+            for block_id in [b for b, v in self._blocks.items()
+                             if v.node_id == node_id]:
+                self._blocks.pop(block_id)
             # Object locations on the node are gone.
-            for oid, locs in list(self._objects.items()):
-                locs.pop(node_id, None)
-                if not locs:
-                    self._objects.pop(oid, None)
+            self._directory.drop_node(node_id)
             # PG bundles on the node lose their reservation.
             needs_reschedule = False
             for pg in self._pgs.values():
@@ -274,7 +314,7 @@ class GcsService:
                 (aid, info) for aid, info in self.store.actors.items()
                 if info.node_id == node_id and info.state in ("ALIVE", "PENDING", "RESTARTING")
             ]
-            self._sched_cv.notify_all()
+            self._wake_all_locked()
         self._publish("node", ("DEAD", node_id.hex(), addr))
         for aid, info in dead_actors:
             self._on_actor_failure(aid, f"node {node_id.hex()[:8]} died")
@@ -286,6 +326,68 @@ class GcsService:
         self._handle_node_death(node_id)
 
     # ====================== leases / scheduling ======================
+
+    # -- wake indexing (satellite: notify_all storms) --------------------------
+
+    @staticmethod
+    def _shape_key(resources: Dict[str, float]) -> tuple:
+        return tuple(sorted(resources.items()))
+
+    def _shape_cond(self, shape_key: tuple,
+                    request: ResourceSet) -> threading.Condition:
+        cond = self._shape_conds.get(shape_key)
+        if cond is None:
+            cond = self._shape_conds[shape_key] = threading.Condition(
+                self._lock)
+            self._shape_sets[shape_key] = request
+        return cond
+
+    def _wake_shapes_locked(self) -> None:
+        """Capacity returned: wake PG waiters (small set, shape-agnostic
+        bundles) plus only the shape classes that could now fit SOMEWHERE.
+        A shape that still fits nowhere stays parked (its ≤1.0s wait slice
+        remains the missed-wake safety net)."""
+        self._sched_cv.notify_all()
+        for shape_key, count in self._shape_waiters.items():
+            if count <= 0:
+                continue
+            if self.scheduler.any_can_fit(self._shape_sets[shape_key]):
+                self._wake_stats["wakes"] += 1
+                self._shape_conds[shape_key].notify_all()
+            else:
+                self._wake_stats["skips"] += 1
+
+    def _wake_all_locked(self) -> None:
+        """Membership / client-death events: anything may be feasible (or
+        newly hopeless) now — wake every parked waiter to re-check."""
+        self._sched_cv.notify_all()
+        for cond in self._shape_conds.values():
+            cond.notify_all()
+
+    # -- incremental pending-demand snapshot (satellite: O(1)-ish poll) --------
+
+    def _demand_add(self, resources: Dict[str, float]) -> int:
+        with self._demand_lock:
+            self._demand_seq += 1
+            demand_id = self._demand_seq
+            self._demand_pos[demand_id] = len(self._demand_list)
+            self._demand_list.append(dict(resources))
+            self._demand_ids.append(demand_id)
+            return demand_id
+
+    def _demand_remove(self, demand_id: int) -> None:
+        with self._demand_lock:
+            pos = self._demand_pos.pop(demand_id, None)
+            if pos is None:
+                return
+            last = len(self._demand_list) - 1
+            if pos != last:
+                # swap-pop: move the tail entry into the vacated slot
+                self._demand_list[pos] = self._demand_list[last]
+                moved = self._demand_ids[pos] = self._demand_ids[last]
+                self._demand_pos[moved] = pos
+            self._demand_list.pop()
+            self._demand_ids.pop()
 
     def request_lease(self, resources: Dict[str, float], strategy=None,
                       timeout: float = 60.0,
@@ -308,75 +410,224 @@ class GcsService:
             pg = strategy.placement_group
             pg_id = pg.id if hasattr(pg, "id") else pg
             bundle_index = strategy.placement_group_bundle_index
-        with self._lock:
-            # Register as pending demand while waiting: the autoscaler reads
-            # this to size the cluster (gcs_autoscaler_state_manager.cc's
-            # demand report). One request may re-enter the wait many times
-            # within its timeout slices — the id keys a single logical wait.
-            self._demand_seq += 1
-            demand_id = self._demand_seq
-            self._waiting_demands[demand_id] = dict(resources)
+        # Register as pending demand while waiting: the autoscaler reads
+        # this to size the cluster (gcs_autoscaler_state_manager.cc's
+        # demand report). One request may re-enter the wait many times
+        # within its timeout slices — the id keys a single logical wait.
+        demand_id = self._demand_add(resources)
         try:
             return self._request_lease_wait(request, resources, strategy,
                                             deadline, timeout, pg_id,
                                             bundle_index, _client_id)
         finally:
-            with self._lock:
-                self._waiting_demands.pop(demand_id, None)
+            self._demand_remove(demand_id)
 
     def _request_lease_wait(self, request, resources, strategy, deadline,
                             timeout, pg_id, bundle_index, _client_id):
+        shape_key = self._shape_key(resources)
         with self._lock:
-            while True:
-                if (isinstance(strategy, NodeAffinitySchedulingStrategy)
-                        and not strategy.soft
-                        and strategy.node_id in self._dead_nodes):
-                    # Hard affinity to a KNOWN-dead node can never be
-                    # satisfied — fail now instead of queueing forever.
-                    # (A merely unknown node may still be registering, e.g.
-                    # right after a GCS restart — those requests wait.)
-                    raise RuntimeError(
-                        f"no feasible node: hard affinity to dead node "
-                        f"{strategy.node_id}")
-                if pg_id is not None:
-                    if pg_id not in self._pgs:
-                        # Group removed (remove_placement_group pops it) —
-                        # indistinguishable from "temporarily full" inside
-                        # _try_pg_lease, so fail fast here instead of
-                        # spinning out the whole timeout. Creation blocks
-                        # before handles exist, so "not yet created" can't
-                        # reach this path.
-                        raise RuntimeError(
-                            f"placement group {pg_id} does not exist "
-                            "(removed?)")
-                if _client_id and _client_id in self._dead_clients:
-                    # Grant-after-death race: the client's cleanup already
-                    # ran while this handler was blocked — granting now
-                    # would leak the lease forever.
-                    raise RuntimeError("client is dead; lease refused")
-                if pg_id is not None:
-                    got = self._try_pg_lease(pg_id, bundle_index, request,
-                                             client_id=_client_id)
-                else:
-                    got = self._try_lease(request, strategy,
-                                          client_id=_client_id)
-                if got is not None:
-                    return got
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"no node can satisfy {resources} within {timeout}s "
-                        f"(cluster: {self.scheduler.available_resources()})"
-                    )
-                self._sched_cv.wait(timeout=min(remaining, 1.0))
+            # Non-PG requests park on their shape's condition so a release
+            # only wakes shape classes that could now fit; PG requests stay
+            # on _sched_cv (bundle state isn't shape-indexable).
+            if pg_id is None:
+                cond = self._shape_cond(shape_key, request)
+            else:
+                cond = self._sched_cv
+            waiting = False
+            try:
+                while True:
+                    got = self._request_lease_try(request, resources,
+                                                  strategy, pg_id,
+                                                  bundle_index, _client_id)
+                    if got is not None:
+                        return got
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no node can satisfy {resources} within "
+                            f"{timeout}s (cluster: "
+                            f"{self.scheduler.available_resources()})")
+                    if not waiting and pg_id is None:
+                        waiting = True
+                        self._shape_waiters[shape_key] = (
+                            self._shape_waiters.get(shape_key, 0) + 1)
+                    # raylint: ignore[blocking-under-lock] — cond is either
+                    # _sched_cv or a _shape_cond; both wrap self._lock.
+                    cond.wait(timeout=min(remaining, 1.0))
+            finally:
+                if waiting:
+                    n = self._shape_waiters.get(shape_key, 1) - 1
+                    if n > 0:
+                        self._shape_waiters[shape_key] = n
+                    else:
+                        # GC the idle shape's index entries so long-running
+                        # clusters don't accrete one cond per shape ever seen.
+                        self._shape_waiters.pop(shape_key, None)
+                        self._shape_conds.pop(shape_key, None)
+                        self._shape_sets.pop(shape_key, None)
+
+    def _request_lease_try(self, request, resources, strategy, pg_id,
+                           bundle_index, _client_id):
+        """One feasibility check + grant attempt; caller holds self._lock."""
+        if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+                and not strategy.soft
+                and strategy.node_id in self._dead_nodes):
+            # Hard affinity to a KNOWN-dead node can never be
+            # satisfied — fail now instead of queueing forever.
+            # (A merely unknown node may still be registering, e.g.
+            # right after a GCS restart — those requests wait.)
+            raise RuntimeError(
+                f"no feasible node: hard affinity to dead node "
+                f"{strategy.node_id}")
+        if pg_id is not None:
+            if pg_id not in self._pgs:
+                # Group removed (remove_placement_group pops it) —
+                # indistinguishable from "temporarily full" inside
+                # _try_pg_lease, so fail fast here instead of
+                # spinning out the whole timeout. Creation blocks
+                # before handles exist, so "not yet created" can't
+                # reach this path.
+                raise RuntimeError(
+                    f"placement group {pg_id} does not exist "
+                    "(removed?)")
+        if _client_id and _client_id in self._dead_clients:
+            # Grant-after-death race: the client's cleanup already
+            # ran while this handler was blocked — granting now
+            # would leak the lease forever.
+            raise RuntimeError("client is dead; lease refused")
+        if pg_id is not None:
+            return self._try_pg_lease(pg_id, bundle_index, request,
+                                      client_id=_client_id)
+        return self._try_lease(request, strategy, client_id=_client_id)
 
     request_lease._rpc_wants_conn = True  # RpcServer injects _client_id
 
+    def request_lease_batch(self, resources: Dict[str, float], strategy=None,
+                            count: int = 1, timeout: float = 60.0,
+                            _client_id: str = ""):
+        """Batched lease grant: one revocable CAPACITY BLOCK of up to
+        ``count`` units of ``resources`` on one node, returned as
+        ``(block_id, node_id, node_address, granted)``.
+
+        The caller's node daemon carves per-task worker leases out of the
+        block locally (``lease_worker_block``), so a deep scheduling-key
+        queue costs one GCS hop instead of ``count``. Partial grants
+        (``granted < count``) are normal; at least one unit is always
+        granted before returning. Unused units flow back via
+        :meth:`return_block_capacity` (daemon idle-TTL sweep) and the whole
+        block is reclaimed on client death (:meth:`on_client_closed`), the
+        same conn-scoped path per-task leases use.
+
+        PG strategies are rejected — bundle accounting is per-task by
+        design; the client falls back to per-task ``request_lease``.
+        """
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            raise ValueError("placement-group leases cannot be batched")
+        request = ResourceSet(resources)
+        count = max(1, int(count))
+        deadline = time.time() + timeout
+        shape_key = self._shape_key(resources)
+        demand_id = self._demand_add(resources)
+        try:
+            with self._lock:
+                cond = self._shape_cond(shape_key, request)
+                waiting = False
+                try:
+                    while True:
+                        if _client_id and _client_id in self._dead_clients:
+                            raise RuntimeError(
+                                "client is dead; lease refused")
+                        if (isinstance(strategy,
+                                       NodeAffinitySchedulingStrategy)
+                                and not strategy.soft
+                                and strategy.node_id in self._dead_nodes):
+                            raise RuntimeError(
+                                f"no feasible node: hard affinity to dead "
+                                f"node {strategy.node_id}")
+                        got = self._try_block(request, strategy, count,
+                                              _client_id)
+                        if got is not None:
+                            break
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                f"no node can satisfy {resources} within "
+                                f"{timeout}s (cluster: "
+                                f"{self.scheduler.available_resources()})")
+                        if not waiting:
+                            waiting = True
+                            self._shape_waiters[shape_key] = (
+                                self._shape_waiters.get(shape_key, 0) + 1)
+                        # raylint: ignore[blocking-under-lock] — the shape
+                        # cond wraps self._lock (see _shape_cond).
+                        cond.wait(timeout=min(remaining, 1.0))
+                finally:
+                    if waiting:
+                        n = self._shape_waiters.get(shape_key, 1) - 1
+                        if n > 0:
+                            self._shape_waiters[shape_key] = n
+                        else:
+                            self._shape_waiters.pop(shape_key, None)
+                            self._shape_conds.pop(shape_key, None)
+                            self._shape_sets.pop(shape_key, None)
+        finally:
+            self._demand_remove(demand_id)
+        block_id, node_id, addr, granted = got
+        # Push the grant to the daemon OUTSIDE the lock so it can start
+        # carving before the client's first lease_worker_block arrives.
+        # Best-effort: the client's carve calls carry an inline adopt hint,
+        # so a lost push only delays, never wedges (and in-process tests
+        # run with no daemon at the node address at all).
+        try:
+            self._daemons.get(addr).notify(
+                "adopt_capacity_block", block_id, dict(resources), granted)
+        except Exception:  # noqa: BLE001 — carve-side adopt hint covers it
+            log_swallowed(logger, "capacity-block adopt push")
+        return block_id, node_id, addr, granted
+
+    request_lease_batch._rpc_wants_conn = True
+
+    def _try_block(self, request: ResourceSet, strategy, count: int,
+                   client_id: str):
+        """Greedy block grant: best node for the shape, then allocate as
+        many units as fit there (>=1). Caller holds self._lock."""
+        node_id = self.scheduler.best_node(request, strategy)
+        if node_id is None or not self.scheduler.try_allocate(node_id, request):
+            return None
+        granted = 1
+        while granted < count and self.scheduler.try_allocate(node_id, request):
+            granted += 1
+        self._next_block += 1
+        block_id = f"cap-{self._next_block}"
+        self._blocks[block_id] = _CapacityBlock(
+            block_id, node_id, request, granted, client_id=client_id)
+        return block_id, node_id, self._node_addr[node_id], granted
+
+    def return_block_capacity(self, block_id: str, n: int) -> bool:
+        """A daemon ships back ``n`` unused units of a block (idle-TTL
+        sweep). False = unknown block (e.g. the GCS restarted and lost it);
+        the daemon then drops its local record instead of retrying."""
+        with self._lock:
+            block = self._blocks.get(block_id)
+            if block is None:
+                return False
+            n = max(0, min(int(n), block.total - block.returned))
+            if n:
+                block.returned += n
+                for _ in range(n):
+                    self.scheduler.release(block.node_id, block.shape)
+                if block.returned >= block.total:
+                    self._blocks.pop(block_id, None)
+                self._wake_shapes_locked()
+            return True
+
     def pending_resource_demands(self) -> List[Dict[str, float]]:
         """Resource shapes of lease requests currently WAITING (queued or
-        infeasible) — what the autoscaler sizes the cluster against."""
-        with self._lock:
-            return list(self._waiting_demands.values())
+        infeasible) — what the autoscaler sizes the cluster against.
+        Maintained incrementally; this is a plain list copy off the
+        scheduling lock."""
+        with self._demand_lock:
+            return list(self._demand_list)
 
     def node_resource_state(self, node_id_bytes: bytes) -> Optional[dict]:
         """Per-node {total, available} for the autoscaler's idle check."""
@@ -433,7 +684,28 @@ class GcsService:
             self._dead_clients.add(client_id)
             orphaned = [l.lease_id for l in self._leases.values()
                         if l.client_id == client_id]
-            self._sched_cv.notify_all()  # wake its blocked requesters
+            # Reclaim the dead client's capacity blocks: everything not yet
+            # returned by the daemon's idle sweep comes back here (the
+            # daemon is told to revoke, so a late return of the same units
+            # finds the block gone and is ignored — freed exactly once).
+            revoked: List[Tuple[str, str]] = []
+            for block_id in [b for b, v in self._blocks.items()
+                             if v.client_id == client_id]:
+                block = self._blocks.pop(block_id)
+                for _ in range(block.total - block.returned):
+                    self.scheduler.release(block.node_id, block.shape)
+                addr = self._node_addr.get(block.node_id)
+                if addr is not None:
+                    revoked.append((block_id, addr))
+            self._wake_all_locked()  # wake its blocked requesters
+        for block_id, addr in revoked:
+            logger.info("revoking capacity block %s after client death",
+                        block_id)
+            try:
+                self._daemons.get(addr).notify("revoke_capacity_block",
+                                               block_id)
+            except Exception:  # noqa: BLE001 — daemon death has its own path
+                log_swallowed(logger, "capacity-block revoke push")
         for lease_id in orphaned:
             logger.info("releasing lease %s after client death", lease_id)
             self.release_lease(lease_id)
@@ -450,7 +722,7 @@ class GcsService:
                     b.in_use = b.in_use - lease.resources
             else:
                 self.scheduler.release(lease.node_id, lease.resources)
-            self._sched_cv.notify_all()
+            self._wake_shapes_locked()
 
     def available_resources(self) -> Dict[str, float]:
         return self.scheduler.available_resources()
@@ -579,7 +851,7 @@ class GcsService:
                 return
             for b in pg.bundles:
                 self.scheduler.release(b.node_id, b.resources)
-            self._sched_cv.notify_all()
+            self._wake_shapes_locked()
 
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[dict]:
         with self._lock:
@@ -755,17 +1027,13 @@ class GcsService:
 
     def add_object_location(self, object_id: bytes, node_id: NodeID,
                             size: int, lineage: bytes | None = None) -> None:
-        with self._lock:
-            self._objects.setdefault(object_id, {})[node_id] = size
-            # Track task membership for every sealed object (siblings may
-            # register before the lineage-bearing first return arrives).
-            tk = self._task_key(object_id)
-            self._task_objects.setdefault(tk, set()).add(object_id)
-            if lineage is not None and tk not in self._lineage:
-                if len(self._lineage) >= self._lineage_cap:
-                    self._lineage.pop(next(iter(self._lineage)))
-                self._lineage[tk] = lineage
-            addr = self._node_addr.get(node_id)
+        # Sharded fast path: the directory write takes only the owning
+        # shard's lock — a location storm never touches self._lock.
+        # _node_addr reads are GIL-atomic dict gets on a rarely-mutated
+        # table (membership changes), safe without the scheduling lock.
+        self._directory.add_location(object_id, node_id, size,
+                                     lineage=lineage)
+        addr = self._node_addr.get(node_id)
         self._publish(self._OBJ_LOC_CHANNEL,
                       (object_id, node_id, addr, size))
 
@@ -773,46 +1041,26 @@ class GcsService:
         """Register a task's lineage WITHOUT a location row — inline-small
         returns have no sealed replica, but their (possibly large) sibling
         returns still need the creating TaskSpec for reconstruction."""
-        with self._lock:
-            tk = self._task_key(object_id)
-            if tk not in self._lineage:
-                if len(self._lineage) >= self._lineage_cap:
-                    self._lineage.pop(next(iter(self._lineage)))
-                self._lineage[tk] = lineage
+        self._directory.add_lineage(object_id, lineage)
 
     def remove_object_location(self, object_id: bytes, node_id: NodeID) -> None:
-        with self._lock:
-            locs = self._objects.get(object_id)
-            if locs:
-                locs.pop(node_id, None)
-                if not locs:
-                    self._objects.pop(object_id, None)
+        self._directory.remove_location(object_id, node_id)
 
     def locate_object(self, object_id: bytes) -> List[Tuple[NodeID, str, int]]:
         """[(node_id, node_address, size)] for every live replica."""
-        with self._lock:
-            out = []
-            for node_id, size in self._objects.get(object_id, {}).items():
-                addr = self._node_addr.get(node_id)
-                if addr is not None:
-                    out.append((node_id, addr, size))
-            return out
+        out = []
+        for node_id, size in self._directory.locations(object_id).items():
+            addr = self._node_addr.get(node_id)
+            if addr is not None:
+                out.append((node_id, addr, size))
+        return out
 
     def locate_object_batch(
             self, object_ids: List[bytes]
     ) -> List[List[Tuple[NodeID, str, int]]]:
         """Batched :meth:`locate_object`: one RPC resolves every ref of a
         get([refs]) call instead of one round trip per miss."""
-        with self._lock:
-            out = []
-            for object_id in object_ids:
-                locs = []
-                for node_id, size in self._objects.get(object_id, {}).items():
-                    addr = self._node_addr.get(node_id)
-                    if addr is not None:
-                        locs.append((node_id, addr, size))
-                out.append(locs)
-            return out
+        return [self.locate_object(oid) for oid in object_ids]
 
     def subscribe_object_locations(self, cursor: Optional[int],
                                    timeout: float = 30.0,
@@ -831,61 +1079,18 @@ class GcsService:
         per-key pubsub index, ``src/ray/pubsub/publisher.h``). ``None``
         preserves the unfiltered firehose."""
         channel = self._OBJ_LOC_CHANNEL
-        with self._pub_lock:
-            log = self._pub_log.get(channel, [])
-            end = self._pub_base.get(channel, 0) + len(log)
         if cursor is None:
-            return end, []
+            return self._pubsub.end_cursor(channel), []
         if oids is None:
-            return self.poll_channel(channel, cursor, timeout)
-        oidset = {bytes(o) for o in oids}
-        deadline = time.time() + timeout
-        cond = threading.Condition(self._pub_lock)
-        with self._pub_lock:
-            for o in oidset:
-                self._loc_waitlists.setdefault(o, []).append(cond)
-            try:
-                while True:
-                    log = self._pub_log.get(channel, [])
-                    base = self._pub_base.get(channel, 0)
-                    end = base + len(log)
-                    if cursor < end:
-                        matches = [m for m in log[max(0, cursor - base):]
-                                   if bytes(m[0]) in oidset]
-                        cursor = end  # filtered misses are consumed too
-                        if matches:
-                            return end, matches
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        return cursor, []
-                    cond.wait(timeout=remaining)
-            finally:
-                for o in oidset:
-                    lst = self._loc_waitlists.get(o)
-                    if lst is not None:
-                        try:
-                            lst.remove(cond)
-                        except ValueError:
-                            pass
-                        if not lst:
-                            self._loc_waitlists.pop(o, None)
+            return self._pubsub.poll(channel, cursor, timeout)
+        return self._pubsub.poll_filtered(channel, cursor, oids, timeout)
 
     def get_lineage(self, object_id: bytes) -> Optional[bytes]:
-        with self._lock:
-            return self._lineage.get(self._task_key(object_id))
+        return self._directory.get_lineage(object_id)
 
     def free_object(self, object_id: bytes) -> None:
-        with self._lock:
-            locs = self._objects.pop(object_id, {})
-            tk = self._task_key(object_id)
-            live = self._task_objects.get(tk)
-            if live is not None:
-                live.discard(object_id)
-                if not live:
-                    # Last of the task's outputs freed → lineage goes too.
-                    self._task_objects.pop(tk, None)
-                    self._lineage.pop(tk, None)
-            targets = [(n, self._node_addr.get(n)) for n in locs]
+        locs = self._directory.pop_object(object_id)
+        targets = [(n, self._node_addr.get(n)) for n in locs]
         for node_id, addr in targets:
             if addr is None:
                 continue
@@ -935,25 +1140,51 @@ class GcsService:
 
     # ====================== task events / observability ======================
 
+    def _ingest_apply(self, kind: str, args: tuple) -> None:
+        """Drain-thread applier: the ONLY writer of observability tables
+        when async ingest is on."""
+        if kind == "event":
+            self.store.record_task_event(args[0])
+        elif kind == "events":
+            self.store.record_task_events(args[0])
+        elif kind == "metrics":
+            self.store.report_metrics(*args)
+
+    def _ingest_flush(self) -> None:
+        """Read-your-writes barrier for observability READERS: staged
+        reports are applied before the read (bounded wait — a reader never
+        blocks long on a badly lagging ingest)."""
+        if self._ingest is not None:
+            self._ingest.flush(timeout=2.0)
+
     def record_task_event(self, event: dict) -> None:
-        self.store.record_task_event(event)
+        if self._ingest is not None:
+            self._ingest.submit("event", (event,))
+        else:
+            self.store.record_task_event(event)
 
     def record_task_events(self, events: List[dict]) -> None:
         """Batched form — workers flush their task-event buffers here
         (task_event_buffer.cc → gcs_task_manager.cc)."""
-        self.store.record_task_events(events)
+        if self._ingest is not None:
+            self._ingest.submit("events", (events,))
+        else:
+            self.store.record_task_events(events)
 
     def trace(self, trace_id: str) -> List[dict]:
         """Assembled per-trace event list (indexed lookup, no ring scan)."""
+        self._ingest_flush()
         return self.store.trace(trace_id)
 
     def task_events(self) -> List[dict]:
+        self._ingest_flush()
         return self.store.task_events()
 
     def task_events_since(self, cursor: Optional[int],
                           limit: int = 1000) -> Tuple[int, List[dict]]:
         """Cursor'd task-event read — dashboard/state pollers ship only the
         delta instead of copying the whole event log every 2s."""
+        self._ingest_flush()
         return self.store.task_events_since(cursor, limit)
 
     # ====================== cluster metrics plane ======================
@@ -962,50 +1193,60 @@ class GcsService:
                        snapshot: List[dict]) -> None:
         """Per-process exporter reports land here (one coalescable notify
         per process per export interval — metrics_agent → GCS analog)."""
-        self.store.report_metrics(node_id, component, pid, snapshot)
+        if self._ingest is not None:
+            self._ingest.submit("metrics", (node_id, component, pid, snapshot))
+        else:
+            self.store.report_metrics(node_id, component, pid, snapshot)
 
     def metrics_text(self) -> str:
         """Merged cluster-wide Prometheus exposition (dashboard /metrics)."""
+        self._ingest_flush()
         return self.store.metrics_text()
 
     def metrics_summary(self) -> dict:
         """JSON rollup of the live series store (dashboard UI pane)."""
+        self._ingest_flush()
         return self.store.metrics_summary()
+
+    def ingest_stats(self) -> dict:
+        """Staging-queue depth / drop counter (tests + dashboard)."""
+        if self._ingest is None:
+            return {"queued": 0, "dropped": 0, "submitted": 0, "drained": 0}
+        return self._ingest.stats()
+
+    def wake_stats(self) -> dict:
+        """Shape-indexed wake filter counters (tests + dashboard)."""
+        with self._lock:
+            return dict(self._wake_stats)
 
     def _collect_gcs_metrics(self) -> None:
         """Control-plane gauges: scheduler queue depth + lease/node counts."""
         from ray_tpu.core.metrics_export import mirror_stats_gauge
 
+        with self._demand_lock:
+            pending = len(self._demand_list)
         with self._lock:
-            st = {"pending_demands": len(self._waiting_demands),
+            st = {"pending_demands": pending,
                   "leases": len(self._leases),
+                  "capacity_blocks": len(self._blocks),
                   "alive_nodes": len(self._node_addr)}
+        if self._ingest is not None:
+            ing = self._ingest.stats()
+            st["ingest_queued"] = ing["queued"]
+            st["ingest_dropped"] = ing["dropped"]
         mirror_stats_gauge(
             "ray_tpu_gcs_sched",
-            "GCS scheduler state (pending demands, live leases, alive "
-            "nodes)", st)
+            "GCS scheduler state (pending demands, live leases, capacity "
+            "blocks, alive nodes, ingest queue)", st)
 
     # ====================== pubsub (long-poll) ======================
 
     def _publish(self, channel: str, message: Any) -> None:
-        with self._pub_lock:
-            self._pub_log.setdefault(channel, []).append(message)
-            log = self._pub_log[channel]
-            if len(log) > 10_000:
-                drop = len(log) // 2
-                del log[:drop]
-                self._pub_base[channel] = self._pub_base.get(channel, 0) + drop
-            # Per-channel wait list: only this channel's parked polls wake.
-            cond = self._pub_conds.get(channel)
-            if cond is not None:
-                cond.notify_all()
-            if channel == self._OBJ_LOC_CHANNEL:
-                # Per-oid wait list: only filtered subscribes watching THIS
-                # object wake; every other parked subscribe stays asleep.
-                waiters = self._loc_waitlists.get(bytes(message[0]))
-                if waiters:
-                    for c in waiters:
-                        c.notify_all()
+        # Per-oid wait lists apply only to the object-location channel
+        # (filtered subscribes); other channels wake their channel cond.
+        loc_key = (bytes(message[0])
+                   if channel == self._OBJ_LOC_CHANNEL else None)
+        self._pubsub.publish(channel, message, loc_key=loc_key)
 
     def publish(self, channel: str, message: Any) -> None:
         self._publish(channel, message)
@@ -1019,24 +1260,7 @@ class GcsService:
         may miss messages after a very long disconnect, same as the
         reference's bounded pubsub buffers).
         """
-        deadline = time.time() + timeout
-        with self._pub_lock:
-            cond = self._pub_conds.get(channel)
-            if cond is None:
-                cond = self._pub_conds[channel] = threading.Condition(
-                    self._pub_lock)
-            while True:
-                log = self._pub_log.get(channel, [])
-                base = self._pub_base.get(channel, 0)
-                end = base + len(log)
-                if cursor < end:
-                    # Messages below `base` were truncated and are lost
-                    # (bounded buffers, same as the reference's pubsub).
-                    return end, log[max(0, cursor - base):]
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    return cursor, []
-                cond.wait(timeout=remaining)
+        return self._pubsub.poll(channel, cursor, timeout)
 
     # ====================== persistence ======================
 
@@ -1049,7 +1273,7 @@ class GcsService:
                 if (self.store.get_actor(aid) or ActorInfo(aid)).detached
             }
             data = pickle.dumps({
-                "kv": self.store._kv,
+                "kv": self.store.kv_dump(),
                 "functions": self.store._functions,
                 "jobs": self.store.jobs,
                 "detached_actor_specs": detached_specs,
@@ -1113,12 +1337,16 @@ class GcsService:
         except Exception:
             logger.exception("snapshot restore failed; starting fresh")
             return
-        self.store._kv = data.get("kv", {})
+        kv = data.get("kv", {})
+        # kv_load re-routes every key to the CURRENT shard count — the
+        # snapshot format is shard-count-independent (merged namespaces),
+        # so a restart may change gcs_shards freely.
+        self.store.kv_load(kv)
         self.store._functions = data.get("functions", {})
         self.store.jobs = data.get("jobs", {})
         self._pending_detached = data.get("detached_actor_specs", {})
         logger.info("restored snapshot: %d kv namespaces, %d functions, "
-                    "%d detached actors", len(self.store._kv),
+                    "%d detached actors", len(kv),
                     len(self.store._functions),
                     len(getattr(self, "_pending_detached", {})))
 
@@ -1195,6 +1423,8 @@ class GcsService:
     def shutdown(self) -> None:
         self._stopped.set()
         self._metrics_exporter.stop()
+        if self._ingest is not None:
+            self._ingest.stop()
         try:
             self._snapshot()
         except Exception:  # noqa: BLE001 — shutdown is best-effort

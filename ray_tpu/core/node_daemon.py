@@ -179,6 +179,12 @@ class NodeDaemon:
         # channel; raylet kills leased workers on client disconnect).
         self._direct_leases: Dict[WorkerID, str] = {}
         self._dead_clients = BoundedSet()
+        # Daemon-local scheduling plane: GCS-granted capacity blocks carved
+        # into per-task leases here (raylet-side cluster_task_manager
+        # analog). Idle capacity flows back on the TTL sweep below.
+        from ray_tpu.core.lease_table import LocalLeaseTable
+
+        self._lease_table = LocalLeaseTable()
 
         reply = self._gcs.call(
             "register_node", self.node_id, self.address, resources,
@@ -215,6 +221,8 @@ class NodeDaemon:
                          daemon=True).start()
         threading.Thread(target=self._memory_monitor_loop,
                          name="daemon-memmon", daemon=True).start()
+        threading.Thread(target=self._capacity_sweep_loop,
+                         name="daemon-capsweep", daemon=True).start()
 
     # ====================== heartbeat / lifecycle ======================
 
@@ -851,10 +859,119 @@ class NodeDaemon:
                 self._return_worker(worker)
 
     def _release(self, lease_id: str) -> None:
+        from ray_tpu.core.lease_table import is_block_lease
+
+        if is_block_lease(lease_id):
+            # Carved from a local capacity block: the unit returns to the
+            # block's free pool here; the GCS only sees capacity move on
+            # the idle-TTL sweep (or client-death revocation).
+            self._lease_table.release(lease_id)
+            return
         try:
             self._gcs.notify("release_lease", lease_id)
         except RpcConnectionError:
             pass
+
+    # ============ daemon-local lease table (capacity blocks) ============
+
+    def adopt_capacity_block(self, block_id: str, shape: Dict[str, float],
+                             total: int) -> None:
+        """GCS pushes a fresh block grant (best-effort; the client's first
+        lease_worker_block carries the same hint inline)."""
+        self._lease_table.adopt(block_id, shape, int(total))
+
+    def revoke_capacity_block(self, block_id: str) -> None:
+        """GCS reclaimed the block (client death): stop carving; in-flight
+        tasks finish but their units never return to the local pool."""
+        self._lease_table.revoke(block_id)
+
+    def _carve_one(self, block_id: str, shape: Dict[str, float], total: int,
+                   _client_id: str, pop_timeout: float = 60.0):
+        """One (block carve → pooled worker) pair, or None when the block
+        is exhausted/revoked/unknown. Raises WorkerDiedError when a lease
+        was carved but no worker can back it (the unit is released)."""
+        lease_id = self._lease_table.carve(block_id, shape, int(total))
+        if lease_id is None:
+            return None
+        try:
+            worker = self._pop_worker(timeout=pop_timeout)
+        except BaseException as e:  # noqa: BLE001 — carve must not leak
+            self._lease_table.release(lease_id)
+            raise WorkerDiedError(f"worker pool exhausted: {e}") from e
+        refused = False
+        with self._pool_lock:
+            if _client_id and _client_id in self._dead_clients:
+                # Grant-after-death race (see lease_worker).
+                self._return_worker_locked_exit(worker)
+                refused = True
+            else:
+                self._worker_lease[worker.worker_id] = lease_id
+                self._direct_leases[worker.worker_id] = _client_id
+        if refused:
+            self._lease_table.release(lease_id)
+            raise WorkerDiedError("client is dead; worker lease refused")
+        return lease_id, worker.worker_id.binary(), worker.address
+
+    def lease_worker_block(self, block_id: str, shape: Dict[str, float],
+                           total: int, _client_id: str = ""):
+        """Carve one lease from a capacity block AND grant a pooled worker
+        for direct task pushes — the batched sibling of :meth:`lease_worker`
+        with zero GCS hops. Returns ``(lease_id, worker_id, worker_addr)``
+        or None when the block is exhausted/revoked/unknown (the client
+        then re-requests capacity from the GCS)."""
+        return self._carve_one(block_id, shape, int(total), _client_id)
+
+    lease_worker_block._rpc_wants_conn = True  # RpcServer injects _client_id
+
+    def lease_worker_block_n(self, block_id: str, shape: Dict[str, float],
+                             total: int, n: int, _client_id: str = ""):
+        """Carve up to ``n`` (lease, worker) pairs from a capacity block in
+        ONE round trip — the client amortizes the daemon hop across a whole
+        batch grant the same way the batch grant amortized the GCS hop.
+        Returns a possibly-short list of ``(lease_id, worker_id,
+        worker_addr)``; empty when the block is exhausted/revoked/unknown.
+        The first carve may wait the full worker-spawn timeout; later ones
+        wait briefly and return what we have, so one slow spawn never holds
+        an entire batch (the client re-requests the remainder)."""
+        grants: list = []
+        for _ in range(max(1, int(n))):
+            try:
+                got = self._carve_one(block_id, shape, int(total),
+                                      _client_id,
+                                      pop_timeout=60.0 if not grants
+                                      else 5.0)
+            except WorkerDiedError:
+                if grants:
+                    break  # deliver the partial batch; client retries rest
+                raise
+            if got is None:
+                break
+            grants.append(got)
+        return grants
+
+    lease_worker_block_n._rpc_wants_conn = True
+
+    def release_block_lease(self, lease_id: str) -> None:
+        """Worker blocked-release path for block-carved leases: the daemon
+        is the release authority (no GCS hop)."""
+        self._lease_table.release(lease_id)
+
+    def _capacity_sweep_loop(self) -> None:
+        """Ship idle block capacity back to the GCS (the revocable-grant
+        contract: unused units must not sit reserved on this node). A
+        failed return is rolled back and retried next tick; an 'unknown
+        block' reply means the GCS restarted — drop the stale record."""
+        while not self._stopped.wait(0.25):
+            for block_id, n in self._lease_table.sweep_idle(
+                    config().idle_lease_ttl_s):
+                try:
+                    known = self._gcs.call("return_block_capacity",
+                                           block_id, n, timeout=5.0)
+                except (RpcConnectionError, TimeoutError):
+                    self._lease_table.unsweep(block_id, n)
+                    continue
+                if known is False:
+                    self._lease_table.revoke(block_id)
 
     # ============== direct task transport (worker leasing) ==============
 
@@ -916,13 +1033,18 @@ class NodeDaemon:
                 pass
 
     def return_leased_worker(self, worker_id_bytes: bytes) -> None:
-        """Client is done with a directly-leased worker (lease released by
-        the client at the GCS); worker rejoins the vanilla idle pool."""
+        """Client is done with a directly-leased worker; it rejoins the
+        vanilla idle pool. GCS leases are released by the client at the
+        GCS; block-carved leases are released HERE (daemon authority)."""
+        from ray_tpu.core.lease_table import is_block_lease
+
         worker_id = WorkerID(worker_id_bytes)
         with self._pool_lock:
             worker = self._workers.get(worker_id)
-            self._worker_lease.pop(worker_id, None)
+            held = self._worker_lease.pop(worker_id, None)
             self._direct_leases.pop(worker_id, None)
+        if is_block_lease(held):
+            self._lease_table.release(held)
         if worker is not None:
             self._return_worker(worker)
 

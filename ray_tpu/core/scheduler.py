@@ -82,6 +82,13 @@ class ClusterResourceScheduler:
             if nr is not None:
                 nr.release(request)
 
+    def any_can_fit(self, request: ResourceSet) -> bool:
+        """True iff some node could run ``request`` RIGHT NOW. The wake
+        filter for shape-indexed lease waiters — ``best_node`` is the wrong
+        predicate there (it also returns feasible-but-busy nodes)."""
+        with self._lock:
+            return any(nr.can_fit(request) for nr in self._nodes.values())
+
     # -- node selection --------------------------------------------------------
 
     def best_node(

@@ -163,8 +163,30 @@ class WorkerService:
         core.unblocked_after_get = self._reacquire_lease
 
     def _release_lease_while_blocked(self) -> None:
+        from ray_tpu.core.lease_table import is_block_lease
+
         st = getattr(self._task_lease, "value", None)
         if not st or st["released"] or st["lease_id"] is None:
+            return
+        if is_block_lease(st["lease_id"]):
+            # Block-carved lease: the DAEMON is the release authority (the
+            # freed unit rejoins its block's local pool; the GCS learns via
+            # the idle sweep). Reacquire still goes through the GCS
+            # (node-affine request_lease) — prefix dispatch keeps the mixed
+            # lease ids straight.
+            if self._daemon is None:
+                return
+            try:
+                self._daemon.call("release_block_lease", st["lease_id"],
+                                  timeout=10.0)
+            except (RpcConnectionError, TimeoutError):
+                return
+            st["released"] = True
+            try:
+                self._daemon.notify("update_worker_lease", self.worker_id,
+                                    None)
+            except RpcConnectionError:
+                pass
             return
         try:
             self.core._gcs_rpc.notify("release_lease", st["lease_id"])
