@@ -724,6 +724,32 @@ def run_control_plane(quick: bool = False) -> None:
     print(json.dumps({"metric": "control_plane", **results}))
 
 
+def run_slo(quick: bool = False) -> None:
+    """SLO-driven autoscaling bench: the open-loop load harness
+    (``benches/loadgen.py``) sweeps offered load against fixed-1 / fixed-N /
+    autoscaled sim-LLM deployments plus a tenant-quota A/B, and records the
+    p99-TTFT-vs-offered-load curves in ``BENCH_slo_r01.json``. Runs in a
+    fresh interpreter so serve/controller state can't leak into (or out of)
+    the bench; ``--quick`` is the CI smoke (few hundred requests, schema +
+    zero-unexplained-errors assertions inside the child)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "RAY_TPU_METRICS_EXPORT_INTERVAL_S": "0.5"})
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benches", "loadgen.py")
+    cmd = [sys.executable, script]
+    if quick:
+        cmd.append("--quick")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800,
+                       env=env)
+    if r.returncode != 0:
+        print(json.dumps({"metric": "slo_loadgen",
+                          "error": (r.stderr or "")[-400:]}))
+        sys.exit(1)
+    print(json.dumps({"metric": "slo_loadgen", **json.loads(
+        r.stdout.strip().splitlines()[-1])}))
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_bench()
@@ -750,5 +776,7 @@ if __name__ == "__main__":
                                 int(sys.argv[i + 3]))
     elif "--control-plane" in sys.argv:
         run_control_plane(quick="--quick" in sys.argv)
+    elif "--slo" in sys.argv:
+        run_slo(quick="--quick" in sys.argv)
     else:
         main()

@@ -1,0 +1,594 @@
+"""Open-loop, trace-shaped load harness for ray_tpu serve (ISSUE 13).
+
+Drives a serve deployment with OPEN-LOOP traffic — arrivals fire on the
+trace's clock, never gated on completions, so the harness measures how the
+system degrades under offered load instead of politely backing off with it
+(closed-loop generators hide overload; see the "coordinated omission"
+literature). The trace generator is fully seeded and pure: the same
+:class:`TraceConfig` always produces byte-identical request sequences.
+
+Traffic shape:
+
+- **Arrivals** — seeded Poisson, or a two-state Markov-modulated process
+  (calm/burst) whose burst state multiplies the arrival rate.
+- **Lengths** — heavy-tailed (clamped lognormal) prompt and output lengths.
+- **Shared prefixes** — a fraction of requests lead with one of a small
+  pool of common prefixes (system prompts), exercising prefix-affinity
+  routing and KV reuse.
+- **Multi-turn sessions** — a fraction of requests open sessions whose
+  follow-up turns carry the full synthesized history; histories are baked
+  at trace-build time so the generator stays open-loop and deterministic.
+- **Tenants** — requests carry a tenant drawn from a weighted mix, feeding
+  the per-tenant admission quotas (serve/admission.py).
+
+Per request the harness records TTFT, TPOT, completion time and outcome
+("ok" | "shed_saturated" | "shed_quota" | "error:<type>") straight off the
+streaming contract, and emits p99-TTFT-vs-offered-load SLO curves for
+{fixed-1-replica, fixed-N-replica, autoscaled} plus a tenant-isolation
+A/B into ``BENCH_slo_r01.json``.
+
+The default target is a **simulated** LLM deployment (sleep-per-token
+engine with real slot/queue accounting and the real stream contract) so
+the bench measures the serving layer — router, admission, autoscaling —
+against a crisp, machine-independent capacity. The full data plane
+(handle → router → replica actor → autoscaled controller) is real.
+
+Usage:: python benches/loadgen.py [--quick] [--out PATH] [--seed N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# -- trace synthesis ----------------------------------------------------------
+
+
+@dataclass
+class TraceConfig:
+    """Knobs for one synthetic trace. Everything derives from ``seed``."""
+
+    seed: int = 0
+    duration_s: float = 5.0
+    rate_rps: float = 8.0
+    arrival: str = "poisson"  # "poisson" | "bursty"
+    # bursty: two-state Markov chain; burst state multiplies the rate.
+    burst_factor: float = 4.0
+    p_calm_to_burst: float = 0.05
+    p_burst_to_calm: float = 0.2
+    # clamped-lognormal lengths (heavy right tail)
+    prompt_len_mu: float = math.log(24.0)
+    prompt_len_sigma: float = 0.6
+    prompt_len_min: int = 4
+    prompt_len_max: int = 64
+    output_len_mu: float = math.log(12.0)
+    output_len_sigma: float = 0.7
+    output_len_min: int = 2
+    output_len_max: int = 32
+    # shared-prefix mix (system prompts)
+    shared_prefix_frac: float = 0.3
+    prefix_pool: int = 4
+    prefix_len: int = 16
+    # multi-turn sessions: follow-ups carry the synthesized history
+    multi_turn_frac: float = 0.15
+    max_turns: int = 3
+    turn_gap_s: float = 0.6
+    history_cap_tokens: int = 128
+    # tenant -> weight
+    tenants: Dict[str, float] = field(
+        default_factory=lambda: {"default": 1.0})
+    vocab: int = 250
+
+
+@dataclass
+class TraceRequest:
+    t: float  # arrival offset from trace start, seconds
+    prompt_ids: List[int]
+    max_new_tokens: int
+    tenant: str
+    session: str
+    turn: int = 0
+
+
+def _lognormal_int(rng: random.Random, mu: float, sigma: float,
+                   lo: int, hi: int) -> int:
+    return max(lo, min(hi, int(round(rng.lognormvariate(mu, sigma)))))
+
+
+def synth_trace(cfg: TraceConfig) -> List[TraceRequest]:
+    """Build the full request sequence for ``cfg``, sorted by arrival time.
+    Pure function of the config (seeded RNG, no wall clock)."""
+    rng = random.Random(cfg.seed)
+    prefixes = [[rng.randrange(1, cfg.vocab + 1)
+                 for _ in range(cfg.prefix_len)]
+                for _ in range(cfg.prefix_pool)]
+    names = list(cfg.tenants)
+    weights = [cfg.tenants[n] for n in names]
+    out: List[TraceRequest] = []
+    t = 0.0
+    bursting = False
+    session = 0
+    while True:
+        rate = cfg.rate_rps * (cfg.burst_factor if bursting else 1.0)
+        t += rng.expovariate(rate)
+        if t >= cfg.duration_s:
+            break
+        if cfg.arrival == "bursty":
+            flip = rng.random()
+            bursting = (flip >= cfg.p_burst_to_calm if bursting
+                        else flip < cfg.p_calm_to_burst)
+        tenant = rng.choices(names, weights)[0]
+        plen = _lognormal_int(rng, cfg.prompt_len_mu, cfg.prompt_len_sigma,
+                              cfg.prompt_len_min, cfg.prompt_len_max)
+        body = [rng.randrange(1, cfg.vocab + 1) for _ in range(plen)]
+        if rng.random() < cfg.shared_prefix_frac:
+            body = rng.choice(prefixes) + body
+        nout = _lognormal_int(rng, cfg.output_len_mu, cfg.output_len_sigma,
+                              cfg.output_len_min, cfg.output_len_max)
+        session += 1
+        sid = f"s{session}"
+        out.append(TraceRequest(t, body, nout, tenant, sid, 0))
+        if rng.random() < cfg.multi_turn_frac:
+            # Follow-up turns: history = prior prompt + a SYNTHESIZED
+            # assistant reply + the new user turn, baked now — the harness
+            # never waits on a real response to build the next turn.
+            history = list(body)
+            tt = t
+            for turn in range(1, rng.randint(2, cfg.max_turns)):
+                tt += cfg.turn_gap_s * (0.5 + rng.random())
+                if tt >= cfg.duration_s:
+                    break
+                reply = [rng.randrange(1, cfg.vocab + 1)
+                         for _ in range(nout)]
+                user = [rng.randrange(1, cfg.vocab + 1) for _ in range(
+                    _lognormal_int(rng, cfg.prompt_len_mu,
+                                   cfg.prompt_len_sigma,
+                                   cfg.prompt_len_min, cfg.prompt_len_max))]
+                history = (history + reply + user)[-cfg.history_cap_tokens:]
+                nout = _lognormal_int(
+                    rng, cfg.output_len_mu, cfg.output_len_sigma,
+                    cfg.output_len_min, cfg.output_len_max)
+                out.append(TraceRequest(tt, list(history), nout, tenant,
+                                        sid, turn))
+    out.sort(key=lambda r: r.t)
+    return out
+
+
+# -- simulated LLM deployment -------------------------------------------------
+
+
+def sim_llm_deployment(name: str = "SIMLLM", *, slots: int = 4,
+                       prefill_s_per_token: float = 0.0003,
+                       decode_s_per_token: float = 0.02,
+                       max_queue: Optional[int] = None,
+                       **deployment_kwargs):
+    """A serve deployment that behaves like the LLM engines — slot-bounded
+    concurrency, bounded admission queue that sheds :class:`Saturated`,
+    the real streaming contract, ``get_engine_stats`` feeding the
+    controller, TTFT observed into the cluster rollup — but burns wall
+    clock (``time.sleep`` per token, GIL released) instead of FLOPs. The
+    serving layer under test is real; only the model is simulated."""
+    from ray_tpu import serve
+    from ray_tpu.core.config import config as knobs
+    from ray_tpu.serve.errors import Saturated
+
+    q_limit = int(max_queue if max_queue is not None
+                  else knobs().serve_admission_queue_limit)
+    deployment_kwargs.setdefault(
+        "max_concurrency", slots + max(q_limit, 4) + 4)
+
+    @serve.deployment(name=name, **deployment_kwargs)
+    class SimLLM:
+        def __init__(self):
+            self._cv = threading.Condition(threading.Lock())
+            self._busy = 0
+            self._waiting = 0
+
+        def __call__(self, payload):
+            from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                     observe_shed,
+                                                     serve_ttft_hist)
+
+            prompt = payload.get("prompt_ids") or [1] * int(
+                payload.get("prompt_len", 8))
+            n = int(payload.get("max_new_tokens", 8))
+            t0 = time.perf_counter()
+            with self._cv:
+                if q_limit and self._waiting >= q_limit:
+                    observe_shed(name, "saturated")
+                    raise Saturated(
+                        f"engine {name}: {self._waiting} requests already "
+                        f"waiting (serve_admission_queue_limit={q_limit})",
+                        retry_after_s=self._waiting
+                        * knobs().serve_retry_after_item_s)
+                self._waiting += 1
+                try:
+                    while self._busy >= slots:
+                        self._cv.wait(timeout=0.05)
+                finally:
+                    self._waiting -= 1
+                self._busy += 1
+            try:
+                time.sleep(prefill_s_per_token * len(prompt))
+                ttft = time.perf_counter() - t0
+                if metrics_enabled():
+                    serve_ttft_hist().observe(
+                        ttft, {"deployment": name, "phase": "total"})
+                for i in range(max(1, n)):
+                    time.sleep(decode_s_per_token)
+                    item = {"token": (i % 250) + 1, "index": i,
+                            "decode_tps": round(1.0 / decode_s_per_token, 1)}
+                    if i == max(1, n) - 1:
+                        item["finish_reason"] = "stop"
+                        item["ttft_s"] = ttft
+                    yield item
+            finally:
+                with self._cv:
+                    self._busy -= 1
+                    self._cv.notify_all()
+
+        def get_engine_stats(self):
+            with self._cv:
+                return {"slots_total": slots, "slots_busy": self._busy,
+                        "queue_depth": self._waiting}
+
+    return SimLLM
+
+
+# -- open-loop runner ---------------------------------------------------------
+
+
+def _classify(exc: BaseException):
+    """Map a raised exception to an outcome, walking the cause chain (shed
+    errors may arrive wrapped after the replica -> client hop)."""
+    from ray_tpu.serve.errors import Saturated
+
+    cur: Optional[BaseException] = exc
+    while cur is not None:
+        if isinstance(cur, Saturated):
+            reason = "shed_quota" if cur.reason == "quota" \
+                else "shed_saturated"
+            return reason, cur.retry_after_s
+        cur = cur.__cause__
+    return f"error:{type(exc).__name__}", None
+
+
+def run_trace(handle, trace: List[TraceRequest],
+              join_timeout_s: float = 60.0) -> List[dict]:
+    """Fire ``trace`` at ``handle`` open-loop: a scheduler walks arrivals
+    on the wall clock and hands each request to its own worker thread —
+    a slow or shedding server NEVER slows the offered load. Returns one
+    record per request."""
+    records: List[dict] = []
+    lock = threading.Lock()
+    threads: List[threading.Thread] = []
+    start = time.perf_counter()
+
+    def worker(req: TraceRequest) -> None:
+        rec = {"t": req.t, "tenant": req.tenant, "turn": req.turn,
+               "outcome": "ok", "ttft_s": None, "tpot_s": None,
+               "total_s": None, "tokens": 0, "retry_after_s": None}
+        t0 = time.perf_counter()
+        try:
+            first = None
+            count = 0
+            for item in handle.options(stream=True).remote(
+                    {"prompt_ids": req.prompt_ids,
+                     "max_new_tokens": req.max_new_tokens,
+                     "tenant": req.tenant}):
+                now = time.perf_counter()
+                if first is None:
+                    first = now - t0
+                count += 1
+                assert {"token", "index", "decode_tps"} <= set(item)
+            total = time.perf_counter() - t0
+            rec["ttft_s"] = first
+            rec["total_s"] = total
+            rec["tokens"] = count
+            if count > 1 and first is not None:
+                rec["tpot_s"] = (total - first) / (count - 1)
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            rec["outcome"], rec["retry_after_s"] = _classify(exc)
+        with lock:
+            records.append(rec)
+
+    for req in trace:
+        delay = req.t - (time.perf_counter() - start)
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=worker, args=(req,), daemon=True)
+        th.start()
+        threads.append(th)
+    deadline = time.perf_counter() + join_timeout_s
+    for th in threads:
+        th.join(timeout=max(0.0, deadline - time.perf_counter()))
+    with lock:
+        return list(records)
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(math.ceil(q / 100.0 * len(vals))) - 1)
+    return vals[max(0, idx)]
+
+
+def summarize(records: List[dict], slo_s: float,
+              warmup_s: float = 0.0) -> dict:
+    """Aggregate one load level. ``warmup_s`` drops requests that ARRIVED
+    before it — steady-state measurement, standard warm-up exclusion (the
+    autoscaled scenario needs a few seconds to react; the curve reports
+    the system it scaled INTO, the raw shed counts still show the cost)."""
+    measured = [r for r in records if r["t"] >= warmup_s]
+    ok = [r for r in measured if r["outcome"] == "ok"
+          and r["ttft_s"] is not None]
+    ttfts = [r["ttft_s"] for r in ok]
+    tpots = [r["tpot_s"] for r in ok if r["tpot_s"] is not None]
+    n = len(measured)
+    within = sum(1 for r in ok if r["ttft_s"] <= slo_s)
+    shed_sat = sum(1 for r in measured
+                   if r["outcome"] == "shed_saturated")
+    shed_quota = sum(1 for r in measured if r["outcome"] == "shed_quota")
+    errors = sorted({r["outcome"] for r in measured
+                     if r["outcome"].startswith("error:")})
+    return {
+        "requests": n,
+        "ok": len(ok),
+        "shed_saturated": shed_sat,
+        "shed_quota": shed_quota,
+        "error_kinds": errors,
+        "errors": sum(1 for r in measured
+                      if r["outcome"].startswith("error:")),
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p99_s": _percentile(ttfts, 99),
+        "tpot_p50_s": _percentile(tpots, 50),
+        # SLO attainment over ALL offered requests: a shed request is a
+        # missed SLO, not a excused one.
+        "slo_attainment": (within / n) if n else None,
+    }
+
+
+# -- scenarios ----------------------------------------------------------------
+
+SLOTS = 4
+DECODE_S = 0.02
+SLO_TTFT_S = 0.3
+MAX_REPLICAS = 3
+IDLE_TIMEOUT_S = 2.5
+
+
+def _autoscaling_config():
+    return {
+        "min_replicas": 1, "max_replicas": MAX_REPLICAS,
+        "target_ongoing_requests": float(SLOTS), "target_queue_depth": 2.0,
+        "upscale_delay_s": 0.0, "downscale_delay_s": 1.0,
+        "ttft_p99_slo_s": SLO_TTFT_S, "idle_timeout_s": IDLE_TIMEOUT_S,
+        "hysteresis": 0.1,
+    }
+
+
+def _replica_count(name: str) -> int:
+    import ray_tpu
+    from ray_tpu.serve.controller import get_or_create_controller
+
+    info = ray_tpu.get(
+        get_or_create_controller().list_deployments.remote())
+    return int(info.get(name, {}).get("num_replicas", 0))
+
+
+def run_slo_curve(mode: str, rates: List[float], duration_s: float,
+                  seed: int) -> dict:
+    """One p99-TTFT-vs-offered-load curve: deploy the sim LLM in ``mode``
+    ({fixed1, fixedN, autoscaled}) and sweep offered rates low -> high
+    against the same deployment (the autoscaled run carries its scale
+    between levels, like real traffic ramps do)."""
+    from ray_tpu import serve
+
+    dep_name = f"sim-{mode}"
+    sim = sim_llm_deployment(dep_name, slots=SLOTS,
+                             decode_s_per_token=DECODE_S)
+    if mode == "fixed1":
+        app = sim.options(num_replicas=1)
+    elif mode == "fixedN":
+        app = sim.options(num_replicas=MAX_REPLICAS)
+    elif mode == "autoscaled":
+        app = sim.options(num_replicas=1,
+                          autoscaling_config=_autoscaling_config())
+    else:
+        raise ValueError(mode)
+    handle = serve.run(app.bind(), name=mode)
+    curve = []
+    try:
+        for i, rate in enumerate(rates):
+            cfg = TraceConfig(seed=seed + i, rate_rps=rate,
+                              duration_s=duration_s, arrival="bursty",
+                              burst_factor=2.0)
+            records = run_trace(handle, cfg_trace := synth_trace(cfg))
+            level = summarize(records, SLO_TTFT_S,
+                              warmup_s=duration_s * 0.5)
+            level["offered_rps"] = rate
+            level["offered_requests"] = len(cfg_trace)
+            level["replicas_at_end"] = _replica_count(dep_name)
+            curve.append(level)
+        result = {"mode": mode, "curve": curve}
+        if mode == "autoscaled":
+            # Burst over: the deployment must fall back to min_replicas
+            # within ~one idle timeout (plus signal/poll latency).
+            t0 = time.perf_counter()
+            budget = IDLE_TIMEOUT_S + 4.0
+            while _replica_count(dep_name) > 1 \
+                    and time.perf_counter() - t0 < budget:
+                time.sleep(0.1)
+            back_s = time.perf_counter() - t0
+            result["scale_back_s"] = round(back_s, 2)
+            result["scaled_back_to_min"] = _replica_count(dep_name) == 1
+        return result
+    finally:
+        serve.shutdown()
+
+
+def sustained_rps(curve: List[dict], attainment: float = 0.99) -> float:
+    """Highest offered rate the system sustained at the SLO: p99-TTFT
+    attainment over ALL offered requests >= ``attainment``."""
+    best = 0.0
+    for level in curve:
+        att = level.get("slo_attainment")
+        if att is not None and att >= attainment:
+            best = max(best, level["offered_rps"])
+    return best
+
+
+def run_tenant_isolation(duration_s: float, seed: int) -> dict:
+    """Quota A/B: tenant A offered far over its admission quota, tenant B
+    in quota — B's SLO attainment must stay within 10% of B's solo run on
+    the same deployment shape."""
+    from ray_tpu import serve
+
+    def deploy(tag: str):
+        sim = sim_llm_deployment(f"sim-tenants-{tag}", slots=SLOTS,
+                                 decode_s_per_token=DECODE_S)
+        app = sim.options(num_replicas=2,
+                          tenant_quotas={"A": 2.0, "*": 10_000.0})
+        return serve.run(app.bind(), name=f"tenants-{tag}")
+
+    b_cfg = TraceConfig(seed=seed + 100, rate_rps=6.0,
+                        duration_s=duration_s,
+                        tenants={"B": 1.0})
+    a_cfg = TraceConfig(seed=seed + 200, rate_rps=12.0,
+                        duration_s=duration_s,
+                        tenants={"A": 1.0})
+
+    handle = deploy("mixed")
+    try:
+        mixed_trace = sorted(synth_trace(b_cfg) + synth_trace(a_cfg),
+                             key=lambda r: r.t)
+        mixed = run_trace(handle, mixed_trace)
+    finally:
+        serve.shutdown()
+    handle = deploy("solo")
+    try:
+        solo = run_trace(handle, synth_trace(b_cfg))
+    finally:
+        serve.shutdown()
+
+    warm = duration_s * 0.25
+    b_mixed = summarize([r for r in mixed if r["tenant"] == "B"],
+                        SLO_TTFT_S, warmup_s=warm)
+    a_mixed = summarize([r for r in mixed if r["tenant"] == "A"],
+                        SLO_TTFT_S, warmup_s=warm)
+    b_solo = summarize(solo, SLO_TTFT_S, warmup_s=warm)
+    att_mixed = b_mixed["slo_attainment"] or 0.0
+    att_solo = b_solo["slo_attainment"] or 0.0
+    return {
+        "tenant_b_mixed": b_mixed,
+        "tenant_b_solo": b_solo,
+        "tenant_a_mixed": a_mixed,
+        "quota_sheds": a_mixed["shed_quota"],
+        "b_attainment_delta": round(att_solo - att_mixed, 4),
+        "isolation_within_10pct": att_mixed >= att_solo - 0.10,
+    }
+
+
+# -- entry point --------------------------------------------------------------
+
+def run_all(quick: bool, seed: int) -> dict:
+    if quick:
+        rates, duration = [4.0, 8.0, 16.0], 5.0
+    else:
+        rates, duration = [4.0, 8.0, 12.0, 16.0, 24.0, 32.0], 10.0
+    curves = {}
+    for mode in ("fixed1", "fixedN", "autoscaled"):
+        curves[mode] = run_slo_curve(mode, rates, duration, seed)
+        print(json.dumps({"progress": mode,
+                          "levels": len(curves[mode]["curve"])}),
+              flush=True)
+    tenants = run_tenant_isolation(duration, seed)
+
+    f1 = sustained_rps(curves["fixed1"]["curve"])
+    auto = sustained_rps(curves["autoscaled"]["curve"])
+    unexplained = sum(level["errors"] for c in curves.values()
+                      for level in c["curve"])
+    unexplained += tenants["tenant_b_mixed"]["errors"] \
+        + tenants["tenant_a_mixed"]["errors"] \
+        + tenants["tenant_b_solo"]["errors"]
+    acceptance = {
+        "slo_ttft_s": SLO_TTFT_S,
+        "fixed1_sustained_rps": f1,
+        "fixedN_sustained_rps": sustained_rps(curves["fixedN"]["curve"]),
+        "autoscaled_sustained_rps": auto,
+        "autoscaled_vs_fixed1": round(auto / f1, 2) if f1 else None,
+        "autoscaled_ge_1p5x_fixed1": bool(f1 and auto >= 1.5 * f1),
+        "scale_back_s": curves["autoscaled"].get("scale_back_s"),
+        "scaled_back_to_min": curves["autoscaled"].get(
+            "scaled_back_to_min"),
+        "quota_sheds": tenants["quota_sheds"],
+        "tenant_isolation_within_10pct": tenants["isolation_within_10pct"],
+        "unexplained_errors": unexplained,
+    }
+    return {"slo_curves": curves, "tenant_isolation": tenants,
+            "acceptance": acceptance}
+
+
+def check_schema(results: dict) -> None:
+    """--quick smoke contract: the curve file has the promised shape and
+    zero unexplained (non-shed) errors."""
+    assert set(results) >= {"slo_curves", "tenant_isolation", "acceptance"}
+    for mode in ("fixed1", "fixedN", "autoscaled"):
+        curve = results["slo_curves"][mode]["curve"]
+        assert curve, f"empty curve for {mode}"
+        for level in curve:
+            assert {"offered_rps", "ttft_p99_s", "slo_attainment",
+                    "requests"} <= set(level)
+    acc = results["acceptance"]
+    assert acc["unexplained_errors"] == 0, \
+        f"unexplained errors: {acc['unexplained_errors']}"
+    assert acc["quota_sheds"] > 0, "quota scenario never shed"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="small sweep + schema/zero-error smoke")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None,
+                        help="output path (default: repo-root "
+                             "BENCH_slo_r01.json)")
+    args = parser.parse_args()
+
+    # Fresh rollups: the SLO loop's TTFT read needs sub-second exports.
+    os.environ.setdefault("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.5")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import ray_tpu
+
+    ray_tpu.init()
+    try:
+        results = run_all(args.quick, args.seed)
+    finally:
+        ray_tpu.shutdown()
+    if args.quick:
+        check_schema(results)
+
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_slo_r01.json")
+    with open(out, "w") as f:
+        json.dump({"results": results}, f, indent=2)
+    print(json.dumps({"bench": "slo_loadgen", "quick": args.quick,
+                      **results["acceptance"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -106,6 +106,16 @@ class GcsAutoscalerView:
         return self._core._gcs_rpc.call("pending_resource_demands",
                                         timeout=30.0)
 
+    def pending_block_capacity(self) -> List[Dict[str, float]]:
+        """Outstanding capacity-block grants (granted to a daemon, not yet
+        carved into running leases) — credited as pending capacity so a
+        block in flight doesn't double-launch a node."""
+        try:
+            return self._core._gcs_rpc.call("pending_block_capacity",
+                                            timeout=30.0)
+        except Exception:  # noqa: BLE001 — older GCS without the RPC
+            return []
+
     def retry_infeasible(self) -> None:
         # Queued lease requests wake on the GCS scheduler CV when the new
         # node registers — nothing to do driver-side.
@@ -167,6 +177,18 @@ class Autoscaler:
                 # Still booting: its capacity is on the way — count it so a
                 # slow cloud boot doesn't launch a duplicate every tick.
                 pending_capacity.append(dict(inst.resources))
+        # Granted-but-unadopted capacity blocks (batched daemon leases) are
+        # capacity already carved out of the cluster for queued work: credit
+        # them too, or each outstanding block reads as unmet demand and
+        # double-launches a node. getattr: older runtimes lack the hook.
+        block_capacity = getattr(self.runtime, "pending_block_capacity", None)
+        if block_capacity is not None:
+            try:
+                pending_capacity.extend(
+                    dict(c) for c in block_capacity() or ())
+            except Exception:  # noqa: BLE001 — advisory credit only
+                logger.debug("pending_block_capacity read failed",
+                             exc_info=True)
 
         if demands:
             launches = bin_pack(demands, list(self._types.values()), existing,

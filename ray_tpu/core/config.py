@@ -266,6 +266,23 @@ class Config:
     # the affinity hash. Smaller = coarser grouping (more traffic lands on
     # one replica), larger = only near-identical prompts share a replica.
     serve_prefix_affinity_blocks = _Flag(4)
+    # Per-queued-request service-time estimate (seconds) used to turn an
+    # observed admission-queue depth into the Saturated.retry_after_s
+    # backoff hint (hint = overage x this). Advisory only — it never gates
+    # admission, it just shapes client retry jitter.
+    serve_retry_after_item_s = _Flag(0.05)
+    # Minimum seconds between SLO-autoscaler evaluations per deployment
+    # (serve/autoscaling.py): the controller reconcile loop ticks at 50ms
+    # but pressure signals (polled replica load, pushed ongoing EWMA) only
+    # refresh on coarser cadences — deciding faster than this just reads
+    # the same stale inputs. Direction changes are additionally gated by
+    # the per-deployment cooldowns in AutoscalingConfig.
+    serve_autoscaling_interval_s = _Flag(0.25)
+    # Minimum seconds between cluster-metrics-rollup reads for the TTFT
+    # p99 override (one merged ray_tpu_serve_ttft_s histogram fetch per
+    # deployment): bounds the GCS aggregator query rate from the serve
+    # controller regardless of its reconcile cadence.
+    serve_slo_rollup_interval_s = _Flag(1.0)
 
     # -- control plane (sharded GCS + daemon-local leases) ---------------------
     # Lock domains for the GCS object-location / KV / pubsub tables: state

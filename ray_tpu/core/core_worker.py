@@ -173,6 +173,14 @@ class _GcsClientAdapter:
     def metrics_summary(self) -> dict:
         return self._client.call("metrics_summary")
 
+    def metrics_histogram(self, name: str, tags: dict):
+        """Cluster-merged histogram for one metric (serve SLO TTFT read)."""
+        return self._client.call("metrics_histogram", name, tags)
+
+    def pending_block_capacity(self) -> list:
+        """Outstanding capacity-block units (autoscaler pending credit)."""
+        return self._client.call("pending_block_capacity")
+
     def poll_channel(self, channel: str, cursor: int,
                      poll_timeout: float = 0.0):
         """Read a pubsub channel from ``cursor``; returns (end, messages).

@@ -380,3 +380,8 @@ class GlobalControlStore:
 
     def metrics_summary(self) -> dict:
         return self.metrics.summary()
+
+    def metrics_histogram(self, name: str, tags: dict) -> Optional[dict]:
+        """Cluster-merged histogram for one metric/tag-filter (the serve
+        SLO loop's TTFT read; see MetricsAggregator.histogram_merged)."""
+        return self.metrics.histogram_merged(name, tags)

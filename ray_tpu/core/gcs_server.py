@@ -629,6 +629,22 @@ class GcsService:
         with self._demand_lock:
             return list(self._demand_list)
 
+    def pending_block_capacity(self) -> List[Dict[str, float]]:
+        """Outstanding (granted-but-not-returned) capacity-block units, one
+        scaled resource dict per live block. The autoscaler credits these
+        as pending capacity in ``bin_pack`` so a block a daemon has been
+        granted but not yet adopted into running tasks doesn't look like
+        unmet demand and double-launch a node."""
+        out: List[Dict[str, float]] = []
+        with self._lock:
+            for block in self._blocks.values():
+                units = block.total - block.returned
+                if units <= 0:
+                    continue
+                shape = block.shape.to_dict()
+                out.append({k: v * units for k, v in shape.items()})
+        return out
+
     def node_resource_state(self, node_id_bytes: bytes) -> Optional[dict]:
         """Per-node {total, available} for the autoscaler's idle check."""
         nr = self.scheduler.node_resources(NodeID(node_id_bytes))
@@ -1207,6 +1223,12 @@ class GcsService:
         """JSON rollup of the live series store (dashboard UI pane)."""
         self._ingest_flush()
         return self.store.metrics_summary()
+
+    def metrics_histogram(self, name: str, tags: dict) -> Optional[dict]:
+        """Cluster-merged cumulative histogram for one metric under a tag
+        filter (the serve SLO loop's TTFT read path)."""
+        self._ingest_flush()
+        return self.store.metrics_histogram(name, tags)
 
     def ingest_stats(self) -> dict:
         """Staging-queue depth / drop counter (tests + dashboard)."""

@@ -120,6 +120,41 @@ def serve_kv_hit_tokens_total() -> um.Counter:
                    tag_keys=("deployment",))
 
 
+def serve_shed_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_serve_shed_total",
+                   "Requests shed by serve admission control, by class "
+                   "(saturated=admission queues over limit, quota=tenant "
+                   "over its per-tenant cap)",
+                   tag_keys=("deployment", "reason"))
+
+
+def observe_shed(deployment: str, reason: str) -> None:
+    """Count one shed request (router/handle/engine Saturated raises)."""
+    if metrics_enabled():
+        serve_shed_total().inc(1, {"deployment": deployment,
+                                   "reason": reason})
+
+
+def cluster_histogram(name: str, tags: Dict[str, str]) -> Optional[dict]:
+    """Cluster-merged cumulative histogram from the GCS aggregator —
+    ``{"bounds", "buckets", "sum", "count"}`` summed across every live
+    process's series matching ``tags`` (see
+    :meth:`~ray_tpu.util.metrics.MetricsAggregator.histogram_merged`).
+
+    The read path the serve controller's SLO loop uses for the
+    ``ray_tpu_serve_ttft_s`` override: a direct aggregator call on the
+    in-process runtime, one ``metrics_histogram`` RPC on a multiprocess
+    cluster. None when the runtime is down, the metric has no live
+    samples, or the deployment hasn't reported yet — callers must treat
+    the signal as absent, never as zero."""
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().gcs.metrics_histogram(name, dict(tags))
+    except Exception:  # noqa: BLE001 — rollup is advisory: no runtime /
+        return None    # GCS mid-restart / pre-PR-13 server without the RPC
+
+
 def serve_kv_block_occupancy() -> um.Gauge:
     return _metric(um.Gauge, "ray_tpu_serve_kv_block_occupancy",
                    "Paged KV pool blocks by state "

@@ -432,6 +432,12 @@ class Runtime:
         with self._infeasible_lock:
             return [dict(req) for _, req in self._infeasible]
 
+    def pending_block_capacity(self) -> List[Dict[str, float]]:
+        """Outstanding capacity-block units. The in-process runtime has no
+        batched lease plane, so there is never granted-but-unadopted
+        capacity to credit — the daemon/GCS path overrides this."""
+        return []
+
     def retry_infeasible(self) -> None:
         """Re-schedule parked work after cluster growth."""
         with self._infeasible_lock:

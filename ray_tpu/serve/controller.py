@@ -10,14 +10,16 @@ path NEVER touches the controller (reference's data/control split).
 
 from __future__ import annotations
 
-import math
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.config import config
 from ray_tpu.utils.logging import get_logger, log_swallowed
+from ray_tpu.serve.autoscaling import (DeploymentSignals, SLOPolicy,
+                                       TTFTRollup)
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 
 logger = get_logger("serve_controller")
@@ -46,8 +48,14 @@ class ServeControllerActor:
         self._version = 0
         self._lock = threading.Lock()
         self._running = True
-        self._metrics: Dict[str, float] = {}  # deployment -> reported ongoing
-        self._last_downscale: Dict[str, float] = {}
+        self._metrics: Dict[str, float] = {}  # deployment -> ongoing EWMA
+        self._metrics_t: Dict[str, float] = {}  # deployment -> last report
+        # SLO autoscaling state: one policy per deployment (holds the
+        # hysteresis/cooldown timers) + the rate-limited TTFT rollup reader.
+        self._policies: Dict[str, SLOPolicy] = {}
+        self._ttft = TTFTRollup(
+            min_interval_s=config().serve_slo_rollup_interval_s)
+        self._last_slo_eval: Dict[str, float] = {}
         # deployment -> {replica key -> loaded multiplexed model ids}
         self._model_ids: Dict[str, Dict[str, list]] = {}
         # deployment -> {replica key -> metrics dict (ongoing, slot
@@ -67,6 +75,11 @@ class ServeControllerActor:
         # retire the old version once every NEW replica is ready.
         self._ready: set = set()
         self._ready_probes: Dict[str, Any] = {}  # actor id -> in-flight ref
+        # Replica actor ids observed DEAD (ActorError from a health probe or
+        # the state poll): reconcile culls them from the fleet so the
+        # scale-up loop respawns replacements — a replica lost mid-scale-up
+        # must still converge to the target count.
+        self._dead: set = set()
         self._reconcile_thread = threading.Thread(target=self._loop, daemon=True)
         self._reconcile_thread.start()
 
@@ -173,12 +186,23 @@ class ServeControllerActor:
                     # last-polled per-replica metrics (slots_busy,
                     # queue_depth, ...). Advisory — may lag the poll period.
                     "replica_load": dict(self._replica_load.get(name, {})),
+                    # Per-tenant admission quotas (serve/admission.py);
+                    # handles enforce them in front of the router.
+                    "tenant_quotas": t.config.tenant_quotas,
                 }
             return self._version, table
 
     # -- metrics / autoscaling ----------------------------------------------
     def record_autoscaling_metrics(self, deployment: str, ongoing: float) -> bool:
-        self._metrics[deployment] = ongoing
+        """Handle-side ongoing-requests report (0.2s push cadence). Stores
+        an EWMA so one quiet sample between bursts doesn't zero the scaling
+        signal. This hook ONLY updates the signal — the scaling decision
+        lives solely in the loop's ``_autoscale`` (one decision path; no
+        per-report resize trigger)."""
+        prev = self._metrics.get(deployment)
+        self._metrics[deployment] = (
+            float(ongoing) if prev is None else 0.5 * prev + 0.5 * ongoing)
+        self._metrics_t[deployment] = time.monotonic()
         return True
 
     # -- reconcile loop ------------------------------------------------------
@@ -218,7 +242,11 @@ class ServeControllerActor:
                 try:
                     state = ray_tpu.get(
                         replica.get_state.remote(), timeout=0.5)
-                except Exception:  # noqa: BLE001 — busy or mid-restart:
+                except Exception as e:  # noqa: BLE001 — busy or mid-restart:
+                    from ray_tpu.core.exceptions import ActorError
+
+                    if isinstance(e, ActorError):
+                        self._dead.add(key)  # reconcile respawns it
                     continue       # keep the previous entry
                 ids = state.get("model_ids") or []
                 if ids:
@@ -240,28 +268,79 @@ class ServeControllerActor:
             with self._lock:
                 self._version += 1
 
+    # Ongoing-EWMA reports older than this are treated as zero — a handle
+    # process that died mid-burst must not pin the signal high forever.
+    METRICS_STALE_S = 5.0
+
     def _autoscale(self):
+        """ONE decision path for every scaling signal: delegate each
+        deployment to its :class:`SLOPolicy` over a fused
+        :class:`DeploymentSignals` snapshot (handle EWMA + replica-poll
+        engine stats + TTFT rollup). Rate-limited per deployment by
+        serve_autoscaling_interval_s — the 50ms reconcile tick is far
+        faster than the signals refresh."""
         with self._lock:
             targets = list(self._targets.values())
+        now = time.monotonic()
+        interval = config().serve_autoscaling_interval_s
         for t in targets:
             asc = t.config.autoscaling_config
             if asc is None:
+                self._policies.pop(t.name, None)
                 continue
-            ongoing = self._metrics.get(t.name, 0.0)
-            desired = math.ceil(ongoing / asc.target_ongoing_requests) if ongoing else asc.min_replicas
-            desired = max(asc.min_replicas, min(asc.max_replicas, desired))
-            now = time.monotonic()
-            if desired < t.target_replicas:
-                # hold downscale for the delay window
-                last = self._last_downscale.setdefault(t.name, now)
-                if now - last < asc.downscale_delay_s:
-                    continue
-                self._last_downscale[t.name] = now
-            else:
-                self._last_downscale[t.name] = now
+            if now - self._last_slo_eval.get(t.name, float("-inf")) < interval:
+                continue
+            self._last_slo_eval[t.name] = now
+            policy = self._policies.get(t.name)
+            if policy is None or policy.config is not asc:
+                # New deployment or redeploy with a new config: fresh
+                # policy (cooldown timers reset with the new targets).
+                policy = SLOPolicy(asc)
+                self._policies[t.name] = policy
+            sig = self._build_signals(t, asc, now)
+            desired = policy.desired(t.target_replicas, sig, now)
             if desired != t.target_replicas:
+                logger.info(
+                    "autoscale %s: %d -> %d (pressure=%.2f ttft_p99=%s)",
+                    t.name, t.target_replicas, desired,
+                    policy.pressure(sig), sig.ttft_p99_s)
                 with self._lock:
                     t.target_replicas = desired
+
+    def _build_signals(self, t: _DeploymentTarget, asc: AutoscalingConfig,
+                       now: float) -> DeploymentSignals:
+        """Fuse the per-replica ``get_state`` poll (engine queue/slot/KV
+        stats) with the handle-side ongoing EWMA into one snapshot."""
+        with self._lock:
+            load = dict(self._replica_load.get(t.name, {}))
+            replicas = len([r for v, r in self._replicas.get(t.name, [])
+                            if v == t.version])
+        ongoing = self._metrics.get(t.name, 0.0)
+        if now - self._metrics_t.get(t.name, float("-inf")) \
+                > self.METRICS_STALE_S:
+            ongoing = 0.0
+        queue = busy = total = kv_active = kv_total = polled_ongoing = 0.0
+        for m in load.values():
+            queue += float(m.get("queue_depth") or 0)
+            busy += float(m.get("slots_busy") or 0)
+            total += float(m.get("slots_total") or 0)
+            active = float(m.get("kv_blocks_active") or 0)
+            kv_active += active
+            # Cached blocks are reclaimable; only active vs whole pool
+            # counts as occupancy pressure.
+            kv_total += (active + float(m.get("kv_blocks_cached") or 0)
+                         + float(m.get("kv_blocks_free") or 0))
+            polled_ongoing += float(m.get("ongoing") or 0)
+        ttft = None
+        if asc.ttft_p99_slo_s is not None:
+            ttft = self._ttft.p99(t.name, now)
+        return DeploymentSignals(
+            replicas=max(1, replicas),
+            # The replica poll also counts in-flight requests — take the
+            # larger of the two views (handles may be gone; polls may lag).
+            ongoing=max(ongoing, polled_ongoing),
+            queue_depth=queue, slots_busy=busy, slots_total=total,
+            kv_active=kv_active, kv_total=kv_total, ttft_p99_s=ttft)
 
     # How long a retiring replica may linger past the router-snapshot age
     # while finishing in-flight requests before it is force-killed.
@@ -287,6 +366,20 @@ class ServeControllerActor:
         # gates new-version replicas on self._ready, so a replica turning
         # ready must bump the long-poll version or routers never pick it up.
         ready_before = set(self._ready)
+        # Cull replicas observed dead (ActorError on a probe/poll): dropping
+        # them from the fleet makes the scale-up loop below spawn
+        # replacements — death during scale-up still converges to target.
+        if self._dead:
+            dead, self._dead = self._dead, set()
+            for name in list(self._replicas):
+                kept = [(v, r) for v, r in self._replicas[name]
+                        if r.actor_id.hex() not in dead]
+                if len(kept) != len(self._replicas[name]):
+                    self._replicas[name] = kept
+                    changed = True
+            self._ready -= dead
+            for key in dead:
+                self._ready_probes.pop(key, None)
         for name, t in targets.items():
             current = self._replicas.setdefault(name, [])
             fresh = [(v, r) for v, r in current if v == t.version]
@@ -368,7 +461,11 @@ class ServeControllerActor:
             try:
                 ray_tpu.get(ref, timeout=1.0)
                 self._ready.add(key)
-            except Exception:  # noqa: BLE001 — probe again next tick
+            except Exception as e:  # noqa: BLE001 — probe again next tick
+                from ray_tpu.core.exceptions import ActorError
+
+                if isinstance(e, ActorError):
+                    self._dead.add(key)  # reconcile respawns it
                 all_ready = False
         if len(self._ready) > 4096:  # dead replicas' entries
             self._ready.clear()

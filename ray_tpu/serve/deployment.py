@@ -27,6 +27,7 @@ class Deployment:
             "num_replicas", "max_ongoing_requests", "autoscaling_config",
             "ray_actor_options", "user_config", "health_check_period_s",
             "graceful_shutdown_timeout_s", "max_concurrency",
+            "tenant_quotas",
         }
         cfg_updates = {k: v for k, v in kwargs.items() if k in cfg_fields}
         asc = cfg_updates.get("autoscaling_config")
@@ -77,6 +78,7 @@ def deployment(
     user_config: Optional[Dict] = None,
     route_prefix: Optional[str] = None,
     max_concurrency: int = 1,
+    tenant_quotas: Optional[Dict[str, float]] = None,
 ):
     """``@serve.deployment`` (reference: ``serve/api.py``)."""
 
@@ -98,6 +100,7 @@ def deployment(
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
             max_concurrency=max_concurrency,
+            tenant_quotas=tenant_quotas,
         )
         return Deployment(
             target, name or target.__name__, cfg, route_prefix=route_prefix

@@ -7,12 +7,40 @@ the router/engine raise so callers can distinguish "back off and retry"
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class Saturated(RuntimeError):
-    """Admission control shed: every candidate replica's admission queue is
-    over ``serve_admission_queue_limit`` (or this engine's ``max_queue``).
+    """Admission control shed: the request was refused FAST instead of
+    queueing unboundedly, so the caller can apply its own backpressure
+    (retry with jitter, shed upstream, scale out). The request was NOT
+    started; retrying is always safe.
 
-    Raised FAST — instead of queueing unboundedly — so the caller can apply
-    its own backpressure (retry with jitter, shed upstream, scale out). The
-    request was NOT started; retrying is always safe.
+    ``reason`` distinguishes the shed classes:
+
+    - ``"saturated"`` — every candidate replica's admission queue is over
+      ``serve_admission_queue_limit`` (or this engine's ``max_queue``).
+    - ``"quota"`` — the request's tenant is over its per-tenant admission
+      quota (``DeploymentConfig.tenant_quotas``); other tenants still have
+      capacity.
+
+    ``retry_after_s``, when set, is a backoff hint computed from the
+    observed queue depth (how long the shedding queue likely needs to
+    drain below the limit) — advisory, never a guarantee of admission.
     """
+
+    def __init__(self, message: str = "", *, reason: str = "saturated",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        # Exception pickling replays only positional ``args``; the shed
+        # class and backoff hint must survive the replica → client hop.
+        return (_rebuild_saturated,
+                (str(self), self.reason, self.retry_after_s))
+
+
+def _rebuild_saturated(message, reason, retry_after_s) -> Saturated:
+    return Saturated(message, reason=reason, retry_after_s=retry_after_s)

@@ -31,7 +31,7 @@ class DeploymentResponse:
     MAX_REPLICA_RETRIES = 3
 
     def __init__(self, ref, router: "Router", replica_key: str,
-                 resubmit=None, trace=None):
+                 resubmit=None, trace=None, release=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
@@ -40,6 +40,10 @@ class DeploymentResponse:
         # (parent_ctx, req_ctx, submit_wall_time) from the handle — the
         # serve.request root span closes when the response finishes.
         self._trace = trace
+        # Idempotent tenant-quota release (serve/admission.py); retries
+        # after a replica death run WITHOUT re-acquiring — a request the
+        # tenant was already admitted for is never shed mid-flight.
+        self._release = release
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -75,6 +79,8 @@ class DeploymentResponse:
         if not self._done:
             self._done = True
             self._router._dec(self._replica_key)
+            if self._release is not None:
+                self._release()
             _emit_request_span(self._trace, self._replica_key)
 
     @property
@@ -102,12 +108,14 @@ def _emit_request_span(trace, replica_key: str) -> None:
 
 
 class DeploymentResponseGenerator:
-    def __init__(self, gen, router: "Router", replica_key: str, trace=None):
+    def __init__(self, gen, router: "Router", replica_key: str, trace=None,
+                 release=None):
         self._gen = gen
         self._router = router
         self._replica_key = replica_key
         self._done = False
         self._trace = trace
+        self._release = release
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -124,6 +132,8 @@ class DeploymentResponseGenerator:
             if not self._done:
                 self._done = True
                 self._router._dec(self._replica_key)
+                if self._release is not None:
+                    self._release()
                 _emit_request_span(self._trace, self._replica_key)
 
 
@@ -158,6 +168,22 @@ class Router:
             m = self.__dict__["_affinity"] = {}
         return m
 
+    def _admission(self):
+        """Per-router tenant-quota ledger (serve/admission.py). Lazily
+        created for the same reason as ``_affinity_map``."""
+        adm = self.__dict__.get("_tenant_admission")
+        if adm is None:
+            from ray_tpu.serve.admission import TenantAdmission
+
+            adm = self.__dict__["_tenant_admission"] = TenantAdmission()
+        return adm
+
+    def acquire_tenant(self, tenant, deployment: str):
+        """Admit one request for ``tenant`` against the deployment's quota
+        table; returns the idempotent release callable (or None when no
+        quota applies). Raises Saturated(reason="quota") when over."""
+        return self._admission().acquire(tenant, deployment)
+
     # -- replica set maintenance --------------------------------------------
     def _refresh(self, block: bool = False) -> None:
         now = time.monotonic()
@@ -189,6 +215,9 @@ class Router:
                     aff = self._affinity_map()
                     for h in [h for h, k in aff.items() if k not in live]:
                         del aff[h]
+                # Quota table rides the same snapshot: serve.run updates
+                # apply to in-flight handles on their next refresh.
+                self._admission().update(entry.get("tenant_quotas"))
                 self._last_refresh = now
                 return
             if not block or time.monotonic() > deadline:
@@ -274,9 +303,13 @@ class Router:
                            if prefix_hash is not None else None)
             if replicas:
                 if self._all_shedding(replicas):
+                    from ray_tpu.core.metrics_export import observe_shed
+
+                    observe_shed(self._name, "saturated")
                     raise Saturated(
                         f"deployment {self._name}: every replica's admission "
-                        "queue is over serve_admission_queue_limit")
+                        "queue is over serve_admission_queue_limit",
+                        retry_after_s=self._retry_after_hint(replicas))
                 if aff_key is not None and not self._slots_exhausted(aff_key):
                     pref = next((r for r in replicas
                                  if self._key(r) == aff_key), None)
@@ -315,6 +348,29 @@ class Router:
                 raise TimeoutError(f"no capacity on deployment {self._name}")
             time.sleep(0.002)
 
+    def _retry_after_hint(self, replicas) -> Optional[float]:
+        """Backoff hint for a saturated shed: how long the LEAST-loaded
+        replica's admission queue likely needs to drain back under the
+        limit, at serve_retry_after_item_s per queued item. Advisory."""
+        from ray_tpu.core.config import config
+
+        try:
+            cfg = config()
+            limit = cfg.serve_admission_queue_limit
+            item_s = cfg.serve_retry_after_item_s
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            return None
+        if not limit:
+            return None
+        depths = []
+        for r in replicas:
+            load = self._replica_load.get(self._key(r))
+            if load and load.get("queue_depth") is not None:
+                depths.append(load["queue_depth"])
+        if not depths:
+            return None
+        return max(1, min(depths) - limit + 1) * item_s
+
     # -- metrics push (feeds autoscaling) ------------------------------------
     def total_ongoing(self) -> int:
         with self._lock:
@@ -334,7 +390,8 @@ class DeploymentHandle:
         self._metrics_thread.start()
 
     def options(self, *, method_name: Optional[str] = None, stream: bool = False,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                tenant: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h._name = self._name
         h._controller = self._controller
@@ -345,6 +402,9 @@ class DeploymentHandle:
         h._model_id = (multiplexed_model_id
                        if multiplexed_model_id is not None
                        else getattr(self, "_model_id", ""))
+        # Tenant for per-tenant admission quotas; None = inherit, "" clears.
+        h._tenant = (tenant if tenant is not None
+                     else getattr(self, "_tenant", ""))
         h._metrics_thread = self._metrics_thread
         return h
 
@@ -407,8 +467,22 @@ class DeploymentHandle:
         except Exception:  # noqa: BLE001 — affinity is advisory, never fatal
             return None
 
+    def _resolve_tenant(self, args) -> Optional[str]:
+        """Tenant for quota accounting: ``options(tenant=...)`` wins, else a
+        ``"tenant"`` key on a dict payload (the LLM request shape)."""
+        tenant = getattr(self, "_tenant", "")
+        if tenant:
+            return tenant
+        if args and isinstance(args[0], dict):
+            t = args[0].get("tenant")
+            if t:
+                return str(t)
+        return None
+
     def remote(self, *args, **kwargs):
         from ray_tpu.util import tracing
+        from ray_tpu.core.metrics_export import observe_shed
+        from ray_tpu.serve.errors import Saturated
 
         model_id = getattr(self, "_model_id", "")
         parent_ctx, req_ctx = self._trace_root()
@@ -416,6 +490,14 @@ class DeploymentHandle:
         submit_t = time.time()
         t0 = time.monotonic()
         prefix_hash = self._affinity_hash(args)
+        # Tenant quota gate sits in FRONT of the router: an over-quota
+        # tenant sheds here without consuming any replica queue slot.
+        try:
+            release = self._router.acquire_tenant(
+                self._resolve_tenant(args), self._name)
+        except Saturated:
+            observe_shed(self._name, "quota")
+            raise
         try:
             if req_ctx is not None:
                 tracing.set_context(req_ctx)
@@ -431,7 +513,8 @@ class DeploymentHandle:
                 ).remote(self._method, *args, **kwargs)
                 return DeploymentResponseGenerator(
                     gen, self._router, key,
-                    trace=(parent_ctx, req_ctx, submit_t))
+                    trace=(parent_ctx, req_ctx, submit_t),
+                    release=release)
             ref = replica.handle_request.remote(self._method, *args, **kwargs)
 
             def resubmit(method=self._method, a=args, kw=kwargs,
@@ -441,7 +524,14 @@ class DeploymentHandle:
 
             return DeploymentResponse(ref, self._router, key,
                                       resubmit=resubmit,
-                                      trace=(parent_ctx, req_ctx, submit_t))
+                                      trace=(parent_ctx, req_ctx, submit_t),
+                                      release=release)
+        except BaseException:
+            # Pick/submit failed (saturated shed, timeout): the admission
+            # was never handed to a response object — release it here.
+            if release is not None:
+                release()
+            raise
         finally:
             if req_ctx is not None:
                 tracing.set_context(parent_ctx)

@@ -66,6 +66,24 @@ def _check_token_ids(prompt: np.ndarray, vocab: int, name: str) -> None:
             f"engine {name}: prompt token ids must be in [0, {vocab})")
 
 
+def _shed(name: str, depth: int, limit: int, what: str) -> Saturated:
+    """Build the engine-queue-full :class:`Saturated` (and bump the shed
+    counter): ``retry_after_s`` estimates the queue's drain time at one
+    admitted-item service time per waiting request."""
+    from ray_tpu.core.config import config as _get_config
+    from ray_tpu.core.metrics_export import observe_shed
+
+    observe_shed(name, "saturated")
+    try:
+        retry = depth * _get_config().serve_retry_after_item_s
+    except Exception:  # noqa: BLE001 — hint is advisory, shed regardless
+        retry = None
+    return Saturated(
+        f"engine {name}: {depth} requests {what} "
+        f"(serve_admission_queue_limit={limit})",
+        retry_after_s=retry)
+
+
 class _Request:
     """One in-flight generation: its token queue, slot, and counters.
 
@@ -325,10 +343,8 @@ class LLMEngine:
             return req
         with self._state_lock:
             if self.max_queue and len(self._waiting) >= self.max_queue:
-                raise Saturated(
-                    f"engine {self.name}: {len(self._waiting)} requests "
-                    f"already waiting (serve_admission_queue_limit="
-                    f"{self.max_queue})")
+                raise _shed(self.name, len(self._waiting), self.max_queue,
+                            "already waiting")
             self._waiting.append(req)
         return req
 
@@ -1291,10 +1307,8 @@ class DisaggregatedLLMEngine:
             if self._closed:
                 raise RuntimeError(f"engine {self.name} closed")
             if self.max_queue and len(self._pq) >= self.max_queue:
-                raise Saturated(
-                    f"engine {self.name}: {len(self._pq)} requests already "
-                    f"waiting for prefill (serve_admission_queue_limit="
-                    f"{self.max_queue})")
+                raise _shed(self.name, len(self._pq), self.max_queue,
+                            "already waiting for prefill")
             self._pq.append(t)
             self._cv.notify_all()
         return t

@@ -362,6 +362,46 @@ class MetricsAggregator:
             lines.extend(series)
         return "\n".join(lines) + ("\n" if lines else "")
 
+    def histogram_merged(self, name: str,
+                         tags: Optional[Dict[str, str]] = None,
+                         now: Optional[float] = None) -> Optional[dict]:
+        """One cluster-merged cumulative histogram: bucket counts summed
+        across every live process's samples of ``name`` whose tags contain
+        ``tags`` as a subset (e.g. ``{"deployment": "LM", "phase":
+        "total"}`` merges that deployment's series across all replicas).
+        The controller's SLO loop reads TTFT through this instead of
+        parsing the full exposition. None when no live sample matches;
+        snapshots whose bounds disagree with the first seen (version skew)
+        are skipped."""
+        bounds: Optional[List[float]] = None
+        buckets: List[int] = []
+        total_sum = 0.0
+        total_count = 0
+        want = dict(tags or {})
+        for _key, _ts, snap in self._live(now):
+            for m in snap:
+                if m.get("name") != name or m.get("type") != "histogram":
+                    continue
+                b = list(m.get("bounds") or ())
+                if bounds is None:
+                    bounds = b
+                    buckets = [0] * (len(bounds) + 1)
+                elif b != bounds:
+                    continue
+                for sample_tags, val in m.get("samples", ()):
+                    st = dict(sample_tags)
+                    if any(st.get(k) != v for k, v in want.items()):
+                        continue
+                    counts, s, c = val
+                    for i, n in enumerate(counts[:len(buckets)]):
+                        buckets[i] += n
+                    total_sum += s
+                    total_count += c
+        if bounds is None or total_count == 0:
+            return None
+        return {"bounds": bounds, "buckets": buckets, "sum": total_sum,
+                "count": total_count}
+
     def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
         """JSON rollup for the dashboard UI: live processes + per-metric
         series counts and cluster-wide totals."""
@@ -388,3 +428,31 @@ class MetricsAggregator:
                         ent["total"] += val
         return {"processes": processes,
                 "metrics": sorted(metrics.values(), key=lambda e: e["name"])}
+
+
+def histogram_quantile(q: float, bounds: Sequence[float],
+                       buckets: Sequence[int]) -> Optional[float]:
+    """Approximate quantile from histogram buckets (Prometheus
+    ``histogram_quantile`` semantics): find the bucket holding the q-th
+    observation, interpolate linearly inside it. Observations past the last
+    bound (the +Inf bucket) clamp to the last finite bound — a lower bound
+    on the true quantile, which is the safe direction for an SLO check
+    (never understates load less than reality... it understates, so pair a
+    +Inf-heavy histogram with wider bounds). None when empty."""
+    total = sum(buckets)
+    if total <= 0 or not bounds:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if cum + count >= rank:
+            if i >= len(bounds):  # +Inf bucket
+                return float(bounds[-1])
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - cum) / count
+            return float(lo + (hi - lo) * min(1.0, max(0.0, frac)))
+        cum += count
+    return float(bounds[-1])
