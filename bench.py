@@ -750,6 +750,30 @@ def run_slo(quick: bool = False) -> None:
         r.stdout.strip().splitlines()[-1])}))
 
 
+def run_rl(quick: bool = False) -> None:
+    """Podracer RL throughput bench: ``benches/rl_throughput.py`` runs the
+    {task path, DAG lane} x {runner-local, inference actor} IMPALA grid
+    with alternating-order medians plus the LLM-RL reward-improvement
+    smoke, and records ``BENCH_rl_r01.json``. Fresh interpreter so the
+    in-process runtime and jit caches can't leak across benches;
+    ``--quick`` is the CI smoke (tiny grid, one rep)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benches", "rl_throughput.py")
+    cmd = [sys.executable, script]
+    if quick:
+        cmd.append("--quick")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800,
+                       env=env)
+    if r.returncode != 0:
+        print(json.dumps({"metric": "rl_throughput",
+                          "error": (r.stderr or "")[-400:]}))
+        sys.exit(1)
+    print(json.dumps({"metric": "rl_throughput", **json.loads(
+        r.stdout.strip().splitlines()[-1])}))
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_bench()
@@ -778,5 +802,7 @@ if __name__ == "__main__":
         run_control_plane(quick="--quick" in sys.argv)
     elif "--slo" in sys.argv:
         run_slo(quick="--quick" in sys.argv)
+    elif "--rl" in sys.argv:
+        run_rl(quick="--quick" in sys.argv)
     else:
         main()

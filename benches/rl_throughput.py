@@ -1,0 +1,192 @@
+"""RL throughput bench — Podracer transports and inference placement.
+
+Measures IMPALA env-steps/s over the 2x2 grid
+{task path, DAG rollout lane} x {runner-local (Anakin), inference actor
+(Sebulba)} at two scales, plus the LLM post-training smoke
+(``rllib/llm_rl.py`` — mean reward must strictly improve under a fixed
+seed). Results go to ``BENCH_rl_r01.json``.
+
+Why the scale point looks the way it does: on the per-fragment task
+path the driver pays ``ray_tpu.wait`` + an ObjectRef hop + a fresh
+``sample.remote`` per fragment, and a weight broadcast is N
+``set_weights`` RPCs (~1ms each: pickle + per-runner device_put). Both
+costs scale with runner count and with fragment RATE, not with steps,
+so many runners on short fragments is exactly where the lane transport
+(one compiled-DAG tick, weights ride the tick payload) and the
+inference pool (broadcast touches K actors, not N runners) earn their
+keep. The small-scale row is the honesty check: at few runners on long
+fragments the transports are near parity and the bench records that.
+
+Configurations alternate A/B/A/B across repetitions so drift (thermal,
+page cache, background load) hits every config equally; the recorded
+number is the per-config median.
+
+Usage:: python benches/rl_throughput.py [--quick] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cartpole():
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
+# (label, rollout_lanes, num_inference_actors)
+GRID = [
+    ("task_local", False, 0),
+    ("task_infer", False, 1),
+    ("lanes_local", True, 0),
+    ("lanes_infer", True, 1),
+]
+
+
+def measure(num_runners: int, frag: int, envs: int, lanes: bool,
+            infer: int, *, warmup: int, iters: int) -> dict:
+    """One IMPALA run: build, warm, time ``iters`` train() calls, stop.
+    Returns env-steps/s plus the learner-utilization split (sampling-bound
+    time is ``learner_idle_s`` accumulated around the fragment wait)."""
+    from ray_tpu.rllib import IMPALA, ImpalaConfig
+
+    cfg = ImpalaConfig(env=cartpole, num_env_runners=num_runners,
+                       num_envs_per_runner=envs,
+                       rollout_fragment_length=frag, num_learners=0,
+                       seed=0, rollout_lanes=lanes,
+                       num_inference_actors=infer)
+    algo = IMPALA(cfg)
+    try:
+        for _ in range(warmup):
+            algo.train()
+        idle = 0.0
+        t0 = time.perf_counter()
+        s0 = algo._timesteps
+        for _ in range(iters):
+            idle += algo.train()["learner_idle_s"]
+        wall = time.perf_counter() - t0
+        steps = algo._timesteps - s0
+    finally:
+        algo.stop()
+    return {
+        "env_steps_per_sec": steps / wall,
+        "wall_s": wall,
+        "learner_idle_s": idle,
+        # Fraction of the iteration loop spent waiting for fragments —
+        # the sampling-bound share. The remainder is learner + transport.
+        "learner_idle_frac": idle / wall if wall > 0 else 0.0,
+    }
+
+
+def run_grid(num_runners: int, frag: int, envs: int, *, reps: int,
+             warmup: int, iters: int) -> dict:
+    results = {label: [] for label, _, _ in GRID}
+    # A/B/A/B interleave: one full grid pass per rep.
+    for rep in range(reps):
+        for label, lanes, infer in GRID:
+            r = measure(num_runners, frag, envs, lanes, infer,
+                        warmup=warmup, iters=iters)
+            results[label].append(r)
+            print(json.dumps({"progress": label, "rep": rep,
+                              "steps_per_sec":
+                                  round(r["env_steps_per_sec"], 1)}),
+                  flush=True)
+    out = {"num_runners": num_runners, "fragment_length": frag,
+           "envs_per_runner": envs}
+    for label, runs in results.items():
+        rates = sorted(r["env_steps_per_sec"] for r in runs)
+        med = rates[len(rates) // 2]
+        idle = sorted(r["learner_idle_frac"] for r in runs)[len(runs) // 2]
+        out[label] = {"env_steps_per_sec_median": round(med, 1),
+                      "env_steps_per_sec_all": [round(x, 1) for x in rates],
+                      "learner_idle_frac_median": round(idle, 4)}
+    out["speedup_lanes_infer_vs_task_local"] = round(
+        out["lanes_infer"]["env_steps_per_sec_median"]
+        / out["task_local"]["env_steps_per_sec_median"], 3)
+    return out
+
+
+def run_llm_rl(iters: int) -> dict:
+    """LLM post-training smoke: fixed seed, mean sampled reward over the
+    first third vs last third of iterations must strictly improve."""
+    from ray_tpu.rllib import LLMRL, LLMRLConfig
+
+    algo = LLMRL(LLMRLConfig(seed=0, num_generators=2))
+    try:
+        rewards = []
+        for _ in range(iters):
+            rewards.append(algo.train()["reward_mean"])
+    finally:
+        algo.stop()
+    k = max(1, len(rewards) // 3)
+    start, end = sum(rewards[:k]) / k, sum(rewards[-k:]) / k
+    return {"iterations": iters,
+            "reward_mean_first": round(start, 4),
+            "reward_mean_last": round(end, 4),
+            "reward_improved": bool(end > start),
+            "rewards": [round(r, 4) for r in rewards]}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="CI smoke: tiny grid, one rep, few iterations")
+    parser.add_argument("--out", default=None,
+                        help="output path (default: repo-root "
+                             "BENCH_rl_r01.json)")
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.core.config import config as _cfg
+
+    # Pool pacing at the measured sweet spot (see config.py doc comments):
+    # flush quorum 4, window of roughly one env-step.
+    _cfg().rl_inference_max_batch = 4
+    _cfg().rl_inference_window_s = 0.0003
+    ray_tpu.init(resources={"CPU": 64, "TPU": 8})
+    try:
+        if args.quick:
+            scale = run_grid(4, 8, 4, reps=1, warmup=1, iters=2)
+            small = None
+            llm = run_llm_rl(4)
+        else:
+            # Headline scale point: many runners, short fragments — the
+            # fragment-rate-bound regime the transports are for.
+            scale = run_grid(16, 4, 8, reps=3, warmup=2, iters=5)
+            # Parity check at modest scale.
+            small = run_grid(4, 16, 8, reps=3, warmup=2, iters=5)
+            llm = run_llm_rl(10)
+    finally:
+        ray_tpu.shutdown()
+
+    payload = {"bench": "rl_throughput", "quick": args.quick,
+               "scale": scale, "small": small, "llm_rl": llm}
+    out_path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_rl_r01.json")
+    if not args.quick:
+        with open(out_path, "w") as f:
+            json.dump(payload, f, indent=2)
+    print(json.dumps({
+        "bench": "rl_throughput", "quick": args.quick,
+        "scale_speedup": scale["speedup_lanes_infer_vs_task_local"],
+        "scale_task_local":
+            scale["task_local"]["env_steps_per_sec_median"],
+        "scale_lanes_infer":
+            scale["lanes_infer"]["env_steps_per_sec_median"],
+        "llm_reward_improved": llm["reward_improved"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,6 +284,28 @@ class Config:
     # controller regardless of its reconcile cadence.
     serve_slo_rollup_interval_s = _Flag(1.0)
 
+    # -- rllib (Podracer-scale RL) ---------------------------------------------
+    # Rollout transport for IMPALA/APPO: 1 parks the env runners in a
+    # compiled-DAG rollout lane (rllib/rollout_lanes.py) — fragments fan in
+    # to the driver over multi-slot shm channels with deferred acks, so a
+    # slow learner backpressures the runners instead of dropping work. 0
+    # restores the per-fragment task path (ray_tpu.wait + ObjectRef hop),
+    # kept as the A/B baseline for benches/rl_throughput.py.
+    rollout_lanes_enabled = _Flag(True)
+    # Max observation batches fused into one InferenceActor forward dispatch
+    # (Sebulba mode, rllib/inference.py). 0 = auto: one in-flight step per
+    # attached runner, capped at a flush quorum of 4 — dispatch
+    # amortization saturates there, while waiting on every runner stalls
+    # the pool on the slowest one. Same-shaped requests stack into a
+    # single vmapped dispatch; odd shapes fall back to per-request calls.
+    rl_inference_max_batch = _Flag(0)
+    # Batch window (seconds) an InferenceActor waits for further runner
+    # requests before flushing a partial batch. Runners desync at fragment
+    # boundaries, so a window much larger than one env step leaves the
+    # whole pool blocked on the timer; keep it at roughly one env-step
+    # time so stragglers cost at most one step of latency.
+    rl_inference_window_s = _Flag(0.001)
+
     # -- control plane (sharded GCS + daemon-local leases) ---------------------
     # Lock domains for the GCS object-location / KV / pubsub tables: state
     # is hash-partitioned across this many independent shards so location

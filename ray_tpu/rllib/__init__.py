@@ -11,7 +11,10 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.envs import SyntheticAtariEnv, make_atari
 from ray_tpu.rllib.impala import IMPALA, AggregatorActor, ImpalaConfig, ImpalaLearner, vtrace
+from ray_tpu.rllib.inference import InferenceActor, InferencePool
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.llm_rl import LLMRL, LLMRLConfig, LLMRLLearner
+from ray_tpu.rllib.rollout_lanes import RolloutLanes
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnvRunner,
     MultiAgentPPO,
@@ -62,6 +65,12 @@ __all__ = [
     "APPO",
     "APPOConfig",
     "APPOLearner",
+    "InferenceActor",
+    "InferencePool",
+    "RolloutLanes",
+    "LLMRL",
+    "LLMRLConfig",
+    "LLMRLLearner",
     "CQL",
     "CQLConfig",
     "CQLLearner",

@@ -28,6 +28,7 @@ class SingleAgentEnvRunner:
         seed: int = 0,
         spec: Optional[RLModuleSpec] = None,
         module_factory: Optional[Callable[[RLModuleSpec], Any]] = None,
+        inference: Optional[Any] = None,
     ):
         import gymnasium as gym
 
@@ -64,6 +65,11 @@ class SingleAgentEnvRunner:
             lambda p, o: jnp.argmax(
                 self.module.forward_inference(p, o)["action_dist_inputs"],
                 axis=-1))
+        # Sebulba mode: an InferenceActor handle. The runner keeps its key
+        # stream (split per step, key data shipped with the obs) so the
+        # sampled actions are bitwise-identical to runner-local inference;
+        # only the forward pass moves to the shared, batched actor.
+        self._inference = inference
         self._obs, _ = self._envs.reset(seed=seed)
         # gymnasium >=1.0 vector envs autoreset on the step AFTER done
         # (NEXT_STEP mode): that step ignores the action and returns the new
@@ -128,7 +134,19 @@ class SingleAgentEnvRunner:
             # numpy → CPU device directly: jnp.asarray would materialize on
             # the DEFAULT device first (a tunnel round trip per env step when
             # the default device is a remote TPU)
-            if self._greedy:
+            if self._inference is not None:
+                import ray_tpu
+
+                key_data = (None if self._greedy
+                            else np.asarray(jax.random.key_data(sub)))
+                action_np, logp, value = ray_tpu.get(
+                    self._inference.infer.remote(obs, key_data, self._greedy))
+                if self._greedy and self._epsilon > 0:
+                    explore = self._np_rng.random(N) < self._epsilon
+                    randoms = self._np_rng.integers(
+                        0, self.spec.action_dim, N)
+                    action_np = np.where(explore, randoms, action_np)
+            elif self._greedy:
                 action = self._greedy_fn(
                     self._params, jax.device_put(obs, self._device))
                 logp = jnp.zeros(N)
@@ -173,10 +191,16 @@ class SingleAgentEnvRunner:
 
         # bootstrap value of the final observation
         last_obs = np.asarray(self._obs, obs_dtype).reshape(N, -1)
-        out = self.module.forward_inference(
-            self._params, jax.device_put(last_obs, self._device)
-        )
-        last_val = np.asarray(out["vf_preds"])
+        if self._inference is not None:
+            import ray_tpu
+
+            last_val = np.asarray(ray_tpu.get(
+                self._inference.values.remote(last_obs)))
+        else:
+            out = self.module.forward_inference(
+                self._params, jax.device_put(last_obs, self._device)
+            )
+            last_val = np.asarray(out["vf_preds"])
 
         return {
             "obs": obs_buf,
@@ -191,6 +215,23 @@ class SingleAgentEnvRunner:
             # the CURRENT policy — they need the obs, not our stale value.
             "bootstrap_obs": last_obs,
         }
+
+    def sample_dag(self, payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """One rollout-lane tick (rllib/rollout_lanes.py). A lane-parked
+        actor's execution thread lives inside the DAG loop, so ordinary
+        method calls (``set_weights``/``get_metrics``) would queue behind
+        it forever — weight updates ride the tick payload in and episode
+        metrics ride the fragment out instead."""
+        weights = payload.get("weights")
+        if weights is not None:
+            self.set_weights(weights)
+        fragment = self.sample(int(payload["num_steps"]))
+        fragment["metrics"] = self.get_metrics()
+        return fragment
+
+    def ping(self) -> bool:
+        """Liveness probe for the driver's respawn path."""
+        return True
 
     def get_metrics(self) -> Dict[str, float]:
         completed, self._completed = self._completed, []

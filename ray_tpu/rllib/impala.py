@@ -30,10 +30,15 @@ import jax
 import jax.numpy as jnp
 
 import ray_tpu
+from ray_tpu.core.config import config as _get_config
+from ray_tpu.core.exceptions import ActorError
 from ray_tpu.rllib.algorithm_config import AlgorithmConfigBase
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.rl_module import spec_for_env
+from ray_tpu.utils.logging import get_logger, log_swallowed
+
+logger = get_logger(__name__)
 
 
 def vtrace(
@@ -156,6 +161,19 @@ class ImpalaConfig(AlgorithmConfigBase):
     max_requests_in_flight: int = 2
     broadcast_interval: int = 1          # updates between weight broadcasts
     train_batch_fragments: int = 1       # fragments per learner update
+    # Sebulba split (rllib/inference.py): >0 moves action selection into a
+    # shared pool of this many batching InferenceActors; 0 keeps
+    # runner-local params (the Anakin/colocated mode).
+    num_inference_actors: int = 0
+    # Rollout transport: None defers to the rollout_lanes_enabled system
+    # flag; True/False force the compiled-DAG lane / task path per-algo.
+    rollout_lanes: Optional[bool] = None
+    # Ticks kept in flight on the lane (the max_requests_in_flight analog;
+    # also the weight-broadcast staleness in lane mode).
+    lane_depth: int = 2
+    # Bound on waiting for any one fragment (task-path wait and lane fetch)
+    # before the driver declares the sampler lost.
+    sample_timeout_s: float = 120.0
     gamma: float = 0.99
     lr: float = 5e-4
     vf_loss_coeff: float = 0.5
@@ -220,14 +238,23 @@ class IMPALA:
             self.learner = type(self)._LEARNER_CLS(self.spec, learner_cfg,
                                                    seed=config.seed)
 
-        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
-        self._runners = [
-            runner_cls.remote(
-                config.env, num_envs=config.num_envs_per_runner,
-                seed=config.seed + 1000 * i, spec=self.spec,
-            )
-            for i in range(max(1, config.num_env_runners))
-        ]
+        flags = _get_config()
+        self._use_lanes = (bool(flags.rollout_lanes_enabled)
+                           if config.rollout_lanes is None
+                           else bool(config.rollout_lanes))
+        if config.num_inference_actors > 0:
+            from ray_tpu.rllib.inference import InferencePool
+
+            self._pool = InferencePool(
+                config.num_inference_actors, self.spec, seed=config.seed,
+                num_clients=max(1, config.num_env_runners))
+        else:
+            self._pool = None
+        self._runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [self._make_runner(i)
+                         for i in range(max(1, config.num_env_runners))]
+        self._lanes = None  # built on first lane-mode train()
+        self._pending_weights = None  # lane mode: ride the next tick payload
         if config.num_aggregators > 0:
             agg_cls = ray_tpu.remote(AggregatorActor)
             self._aggregators = [agg_cls.remote()
@@ -242,13 +269,83 @@ class IMPALA:
         self._timesteps = 0
         self._broadcast()
 
+    def _make_runner(self, i: int):
+        kwargs: Dict[str, Any] = dict(
+            num_envs=self.config.num_envs_per_runner,
+            seed=self.config.seed + 1000 * i, spec=self.spec)
+        if self._pool is not None:
+            kwargs["inference"] = self._pool.handle_for(i)
+        return self._runner_cls.remote(self.config.env, **kwargs)
+
     def _broadcast(self):
         weights = self.learner.get_weights()
-        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
+        if self._pool is not None:
+            # Sebulba: K inference actors hold the only sampling params —
+            # the broadcast never touches the N runners.
+            self._pool.set_weights(weights)
+        elif self._lanes is not None:
+            # Lane-parked runners can't serve set_weights calls; the
+            # weights ride the next tick's input payload instead.
+            self._pending_weights = weights
+        else:
+            # Per-runner ack: one dead runner must not abort the whole
+            # broadcast (its respawn reloads current weights anyway).
+            refs = [(r, r.set_weights.remote(weights))
+                    for r in list(self._runners)]
+            for runner, ref in refs:
+                try:
+                    ray_tpu.get(ref)
+                except ActorError:
+                    self._respawn_runner(runner)
 
     def _launch(self, runner):
         ref = runner.sample.remote(self.config.rollout_fragment_length)
         self._inflight[ref] = runner
+
+    def _respawn_runner(self, runner):
+        """A runner died (ActorError from sample/get_metrics): replace it
+        in place with current weights and relaunch its in-flight quota so
+        training continues at full sampling width."""
+        i = self._runners.index(runner)
+        logger.warning("env runner %d died; respawning", i)
+        for ref in [r for r, w in list(self._inflight.items())
+                    if w is runner]:
+            del self._inflight[ref]
+        new = self._make_runner(i)
+        if self._pool is None:
+            ray_tpu.get(new.set_weights.remote(self.learner.get_weights()))
+        self._runners[i] = new
+        if not self._use_lanes:
+            for _ in range(self.config.max_requests_in_flight):
+                self._launch(new)
+        return new
+
+    # -- lane mode -----------------------------------------------------------
+    def _ensure_lanes(self):
+        if self._lanes is None:
+            from ray_tpu.rllib.rollout_lanes import RolloutLanes
+
+            self._lanes = RolloutLanes(
+                self._runners, self.config.rollout_fragment_length,
+                depth=self.config.lane_depth,
+                execute_timeout_s=self.config.sample_timeout_s)
+        return self._lanes
+
+    def _recover_lanes(self, err: BaseException) -> None:
+        """A lane tick failed (stage error or a dead runner starving the
+        gather): tear the lane down, respawn whoever doesn't answer a ping,
+        and let the next tick rebuild it."""
+        logger.warning("rollout lane failed (%s); rebuilding", err)
+        try:
+            self._lanes.teardown()
+        except Exception:  # noqa: BLE001
+            log_swallowed(logger, "rollout lane teardown")
+        self._lanes = None
+        for runner in list(self._runners):
+            try:
+                ray_tpu.get(runner.ping.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001 — dead or wedged either way
+                self._respawn_runner(runner)
 
     def _to_train_batch(self, sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         batch = dict(sample)
@@ -257,31 +354,92 @@ class IMPALA:
         batch.pop("bootstrap_value", None)
         return batch
 
+    def _observe_idle(self, idle: float) -> None:
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 rl_learner_idle_hist)
+
+        if metrics_enabled():
+            rl_learner_idle_hist().observe(idle)
+
     def train(self) -> Dict[str, Any]:
         """One iteration: consume ``num_env_runners`` fragments worth of
         experience asynchronously, updating as results land."""
         cfg = self.config
         t0 = time.perf_counter()
+        target_fragments = max(len(self._runners), cfg.train_batch_fragments)
+        if self._use_lanes:
+            stats = self._train_lanes(target_fragments)
+        else:
+            stats = self._train_tasks(target_fragments)
+        sampled_steps, losses, returns, idle_s = stats
+
+        self._timesteps += sampled_steps
+        self._iteration += 1
+        dt = time.perf_counter() - t0
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 rl_env_steps_total)
+
+        if metrics_enabled():
+            rl_env_steps_total().inc(sampled_steps)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "env_steps_per_sec": sampled_steps / dt,
+            "num_updates": self._updates,
+            "learner_idle_s": idle_s,
+            "time_total_s": dt,
+        }
+
+    def _update_from_fragments(self, frags: List[Dict[str, np.ndarray]]):
+        """One learner step from materialized fragments (lane mode and the
+        driver-side task-path fallback share this)."""
+        batch = (self._to_train_batch(AggregatorActor().aggregate(*frags))
+                 if len(frags) > 1 else self._to_train_batch(dict(frags[0])))
+        loss = self.learner.update(batch)["loss"]
+        self._updates += 1
+        if self._updates % self.config.broadcast_interval == 0:
+            self._broadcast()
+        return loss
+
+    def _train_tasks(self, target_fragments: int):
+        """The per-fragment task path (``rollout_lanes_enabled=0``): keep
+        ``max_requests_in_flight`` sample calls outstanding per runner and
+        consume whichever lands first via ``ray_tpu.wait``."""
+        cfg = self.config
         for runner in self._runners:
             while sum(1 for r, w in self._inflight.items() if w is runner) \
                     < cfg.max_requests_in_flight:
                 self._launch(runner)
 
-        target_fragments = max(len(self._runners), cfg.train_batch_fragments)
         consumed = 0
         losses = []
         sampled_steps = 0
+        idle_s = 0.0
         # Every fragment trains exactly once: leftovers persist on self so
         # aggregation never discards experience, and the loop runs until at
         # least one update landed (fragment targets not divisible by
         # train_batch_fragments would otherwise yield loss=nan iterations).
         while consumed < target_fragments or not losses:
+            w0 = time.perf_counter()
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
-                                    timeout=120.0)
+                                    timeout=cfg.sample_timeout_s)
+            idle = time.perf_counter() - w0
+            idle_s += idle
+            self._observe_idle(idle)
             if not ready:
-                raise TimeoutError("no sample fragment arrived in 120s")
+                raise TimeoutError(
+                    f"no sample fragment arrived in {cfg.sample_timeout_s}s")
             ref = ready[0]
             runner = self._inflight.pop(ref)
+            try:
+                # Probe before counting: a runner death surfaces here (and
+                # the object-store get is a cache hit for the batch below).
+                ray_tpu.get(ref)
+            except ActorError:
+                self._respawn_runner(runner)
+                continue
             self._launch(runner)  # keep the pipeline full
             consumed += 1
             T, N = cfg.rollout_fragment_length, cfg.num_envs_per_runner
@@ -309,21 +467,57 @@ class IMPALA:
             if self._updates % cfg.broadcast_interval == 0:
                 self._broadcast()
 
-        self._timesteps += sampled_steps
-        self._iteration += 1
-        metrics = ray_tpu.get([r.get_metrics.remote() for r in self._runners])
-        returns = [m["episode_return_mean"] for m in metrics
-                   if m["num_episodes"] > 0]
-        dt = time.perf_counter() - t0
-        return {
-            "training_iteration": self._iteration,
-            "timesteps_total": self._timesteps,
-            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
-            "loss": float(np.mean(losses)) if losses else float("nan"),
-            "env_steps_per_sec": sampled_steps / dt,
-            "num_updates": self._updates,
-            "time_total_s": dt,
-        }
+        returns = []
+        metric_refs = [(r, r.get_metrics.remote()) for r in list(self._runners)]
+        for runner, mref in metric_refs:
+            try:
+                m = ray_tpu.get(mref, timeout=cfg.sample_timeout_s)
+            except ActorError:
+                self._respawn_runner(runner)
+                continue
+            if m["num_episodes"] > 0:
+                returns.append(m["episode_return_mean"])
+        return sampled_steps, losses, returns, idle_s
+
+    def _train_lanes(self, target_fragments: int):
+        """The compiled-DAG lane path: fragments stream over multi-slot shm
+        channels, gathered a full tick (one fragment per runner) at a time.
+        Episode metrics ride each fragment; weight broadcasts ride the next
+        tick's payload."""
+        cfg = self.config
+        consumed = 0
+        losses = []
+        sampled_steps = 0
+        idle_s = 0.0
+        returns = []
+        while consumed < target_fragments or not losses:
+            lanes = self._ensure_lanes()
+            w0 = time.perf_counter()
+            try:
+                weights, self._pending_weights = self._pending_weights, None
+                lanes.fill(weights)
+                frags = lanes.next(timeout=cfg.sample_timeout_s)
+            except Exception as err:  # noqa: BLE001 — lane fetch/stage loss
+                self._recover_lanes(err)
+                continue
+            idle = time.perf_counter() - w0
+            idle_s += idle
+            self._observe_idle(idle)
+            for frag in frags:
+                frag = dict(frag)
+                m = frag.pop("metrics", None)
+                if m and m.get("num_episodes", 0) > 0:
+                    returns.append(m["episode_return_mean"])
+                consumed += 1
+                sampled_steps += (cfg.rollout_fragment_length
+                                  * cfg.num_envs_per_runner)
+                # Leftovers persist across ticks/iterations so aggregation
+                # never discards experience (same contract as the task path).
+                self._pending_frags.append(frag)
+                if len(self._pending_frags) >= max(1, cfg.train_batch_fragments):
+                    pend, self._pending_frags = self._pending_frags, []
+                    losses.append(self._update_from_fragments(pend))
+        return sampled_steps, losses, returns, idle_s
 
     def save(self, path: str) -> str:
         from ray_tpu.train.checkpoint import save_pytree
@@ -346,10 +540,18 @@ class IMPALA:
 
     def stop(self) -> None:
         self._inflight.clear()
+        if self._lanes is not None:
+            try:
+                self._lanes.teardown()
+            except Exception:  # noqa: BLE001
+                log_swallowed(logger, "rollout lane teardown")
+            self._lanes = None
         if hasattr(self.learner, "shutdown"):
             self.learner.shutdown()
+        if self._pool is not None:
+            self._pool.stop()
         for r in self._runners + self._aggregators:
             try:
                 ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — already-dead actor at teardown
+                log_swallowed(logger, "actor kill during IMPALA.stop")

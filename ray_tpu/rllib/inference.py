@@ -1,0 +1,208 @@
+"""Sebulba-style inference actors — centralized action selection for RL.
+
+The Podracer "Sebulba" split (PAPERS.md): env runners shed their local
+policy params and ship per-step observation batches to a small shared pool
+of ``InferenceActor``s. Each actor fuses concurrent runner requests into
+ONE jitted forward dispatch (the ``serve/batching.py`` pacing pattern:
+flush on ``rl_inference_max_batch`` or after ``rl_inference_window_s``),
+so a weight broadcast touches K inference actors instead of N runners and
+action selection amortizes a single dispatch over many envs.
+
+Equivalence contract: same-shaped requests stack into a vmapped
+``module.sample_action`` over per-request PRNG keys, which is bitwise
+identical on actions/log-probs to each runner sampling locally with the
+same key (the runner still owns its key stream and splits it per step —
+only the forward+sample computation moves here). Runner-local mode stays
+available as the Anakin/colocated baseline (``ImpalaConfig
+.num_inference_actors=0``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.core.config import config
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.serve.batching import _Batcher
+from ray_tpu.utils.logging import get_logger, log_swallowed
+
+logger = get_logger(__name__)
+
+
+class _Request:
+    __slots__ = ("obs", "key_data", "greedy")
+
+    def __init__(self, obs: np.ndarray, key_data: Optional[np.ndarray],
+                 greedy: bool):
+        self.obs = obs
+        self.key_data = key_data
+        self.greedy = greedy
+
+
+class InferenceActor:
+    """Batched forward passes for a set of env runners.
+
+    Spawn with ``max_concurrency > 1``: concurrent ``infer`` calls block
+    inside ``_Batcher.submit`` until the shared flush runs, which is what
+    lets requests from different runners land in one dispatch.
+    """
+
+    def __init__(
+        self,
+        spec: RLModuleSpec,
+        *,
+        seed: int = 0,
+        module_factory: Optional[Callable[[RLModuleSpec], Any]] = None,
+        max_batch: int = 0,
+        window_s: Optional[float] = None,
+    ):
+        cfg = config()
+        self.spec = spec
+        self.module = (module_factory(spec) if module_factory
+                       else RLModule(spec))
+        # Same placement rationale as the env runner: tiny latency-bound
+        # forwards stay on host CPU (the learner owns the TPU).
+        self._device = jax.local_devices(backend="cpu")[0]
+        self._params = jax.device_put(
+            self.module.init_params(jax.random.key(seed)), self._device)
+        max_batch = int(max_batch or cfg.rl_inference_max_batch or 8)
+        window = float(cfg.rl_inference_window_s
+                       if window_s is None else window_s)
+        self._batcher = _Batcher(self._run_batch, max_batch, window)
+        # vmapped over stacked same-shape requests: one dispatch per flush.
+        self._sample_many = jax.jit(
+            jax.vmap(self.module.sample_action, in_axes=(None, 0, 0)))
+        self._greedy_many = jax.jit(jax.vmap(
+            lambda p, o: jnp.argmax(
+                self.module.forward_inference(p, o)["action_dist_inputs"],
+                axis=-1),
+            in_axes=(None, 0)))
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.forward_inference(p, o)["vf_preds"])
+
+    # -- weights sync (one broadcast target instead of N runners) -----------
+    def set_weights(self, params) -> bool:
+        self._params = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._device), params)
+        return True
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self._params)
+
+    def ping(self) -> bool:
+        return True
+
+    # -- request path --------------------------------------------------------
+    def infer(self, obs: np.ndarray, key_data: Optional[np.ndarray],
+              greedy: bool = False):
+        """One env-runner step: returns ``(actions, logps, values)`` as
+        numpy. Blocks until the shared batch containing it flushes."""
+        action, logp, value = self._batcher.submit(
+            None, _Request(np.asarray(obs), key_data, bool(greedy)))
+        return action, logp, value
+
+    def values(self, obs: np.ndarray) -> np.ndarray:
+        """Critic-only forward for fragment bootstrap values (one call per
+        fragment — not worth the batching window)."""
+        return np.asarray(self._value_fn(
+            self._params, jax.device_put(np.asarray(obs), self._device)))
+
+    def _run_batch(self, requests: List[_Request]):
+        from ray_tpu.core.metrics_export import (metrics_enabled,
+                                                 rl_inference_batch_hist)
+
+        if metrics_enabled():
+            rl_inference_batch_hist().observe(len(requests))
+        results: List[Any] = [None] * len(requests)
+        # Group by (shape, mode) so each group is one stacked dispatch;
+        # mixed shapes (runners with different env counts) simply split
+        # into one dispatch per shape.
+        groups = {}
+        for i, req in enumerate(requests):
+            groups.setdefault((req.obs.shape, req.greedy), []).append(i)
+        for (shape, greedy), idxs in groups.items():
+            obs = jax.device_put(
+                np.stack([requests[i].obs for i in idxs]), self._device)
+            if greedy:
+                actions = np.asarray(self._greedy_many(self._params, obs))
+                n = shape[0]
+                for j, i in enumerate(idxs):
+                    results[i] = (actions[j], np.zeros(n, np.float32),
+                                  np.zeros(n, np.float32))
+            else:
+                keys = jnp.stack([
+                    jax.random.wrap_key_data(
+                        jnp.asarray(requests[i].key_data))
+                    for i in idxs])
+                a, logp, v = self._sample_many(self._params, obs, keys)
+                a, logp, v = np.asarray(a), np.asarray(logp), np.asarray(v)
+                for j, i in enumerate(idxs):
+                    results[i] = (a[j], logp[j], v[j])
+        return results
+
+    def stop(self) -> None:
+        self._batcher.stop()
+
+
+class InferencePool:
+    """Driver-side handle over K inference actors: round-robin runner
+    assignment and the K-way weight broadcast."""
+
+    def __init__(
+        self,
+        num_actors: int,
+        spec: RLModuleSpec,
+        *,
+        seed: int = 0,
+        num_clients: int = 0,
+        module_factory: Optional[Callable[[RLModuleSpec], Any]] = None,
+        window_s: Optional[float] = None,
+    ):
+        assert num_actors > 0
+        cfg = config()
+        # Auto batch size: one in-flight step per attached runner, capped at
+        # a flush quorum of 4. Waiting for EVERY client before flushing
+        # stalls the whole pool on the slowest runner (they desync at
+        # fragment boundaries), and dispatch amortization has already
+        # saturated by ~4 requests — measured 2204 vs 3926 env-steps/s at
+        # 16 runners for quorum 16 vs 4.
+        max_batch = int(cfg.rl_inference_max_batch)
+        if max_batch <= 0:
+            per_actor = max(1, -(-max(num_clients, 1) // num_actors))
+            max_batch = min(per_actor, 4)
+        actor_cls = ray_tpu.remote(InferenceActor)
+        self._actors = [
+            actor_cls.options(max_concurrency=max(8, 2 * max_batch)).remote(
+                spec, seed=seed, module_factory=module_factory,
+                max_batch=max_batch, window_s=window_s)
+            for _ in range(num_actors)
+        ]
+        # Fail fast on construction errors before runners start stepping.
+        ray_tpu.get([a.ping.remote() for a in self._actors])
+
+    @property
+    def actors(self):
+        return list(self._actors)
+
+    def handle_for(self, client_index: int):
+        return self._actors[client_index % len(self._actors)]
+
+    def set_weights(self, params) -> None:
+        ray_tpu.get([a.set_weights.remote(params) for a in self._actors])
+
+    def stop(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.get(a.stop.remote(), timeout=5.0)
+            except Exception:  # noqa: BLE001
+                log_swallowed(logger, "inference actor stop")
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                log_swallowed(logger, "inference actor kill")
