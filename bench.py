@@ -395,6 +395,99 @@ def run_trace_overhead() -> None:
     print(json.dumps({"metric": "trace_overhead", **results}))
 
 
+def run_flight_child(enabled: bool, quick: bool = False) -> None:
+    """A/B child: in-process task hot loop + raw ring-record cost, with the
+    flight recorder on or off (RAY_TPU_FLIGHTREC_ENABLED set by the parent
+    before this interpreter booted, so config resolves it)."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.util import flightrec
+
+    # Rings land in a scratch session dir, not the shared default.
+    os.environ[flightrec.ENV_SESSION_DIR] = tempfile.mkdtemp(
+        prefix="rt_bench_flightrec_")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    for _ in range(50):  # warmup: worker paths + ring mmap page-in
+        ray_tpu.get(nop.remote())
+    n = 200 if quick else 800
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    tasks_per_s = n / (time.perf_counter() - t0)
+
+    # Raw per-event cost of the record hook (lock-free pack_into on a
+    # dirty mmap page when on; one global load + None check when off).
+    m = 20_000 if quick else 200_000
+    t0 = time.perf_counter()
+    for i in range(m):
+        flightrec.record("task", "bench", "hot-loop event")
+    record_ns = (time.perf_counter() - t0) / m * 1e9
+    print(json.dumps({"flightrec_enabled": enabled,
+                      "task_seq_per_s": round(tasks_per_s, 1),
+                      "record_ns": round(record_ns, 1)}))
+
+
+def run_flight_overhead(quick: bool = False,
+                        out: Optional[str] = None) -> None:
+    """Flight-recorder overhead micro: the same in-process task hot loop
+    with the black box on (default) vs ``flightrec_enabled=0``, recorded in
+    ``BENCH_obs_r03.json`` — the A/B that justifies keeping the always-on
+    crash ring. The headline numbers: ring record stays ~1 µs/event and the
+    disabled path is a single flag check."""
+    def trial(setting: str) -> dict:
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "RAY_TPU_FLIGHTREC_ENABLED": setting})
+        cmd = [sys.executable, __file__, "--flight-child", setting]
+        if quick:
+            cmd.append("--quick")
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                           env=env)
+        if r.returncode != 0:
+            print(json.dumps({"metric": "flight_overhead",
+                              "error": (r.stderr or "")[-400:]}))
+            sys.exit(1)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # Alternating trial order + medians, same protocol as the metrics and
+    # tracing A/Bs: shared-box jitter dwarfs a µs-scale write, and a fixed
+    # order folds warmup drift into the comparison.
+    order = ("1", "0") if quick else ("1", "0", "0", "1", "1", "0")
+    trials = {"1": [], "0": []}
+    for setting in order:
+        trials[setting].append(trial(setting))
+
+    def median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    results = {}
+    for setting, key in (("1", "on"), ("0", "off")):
+        results[f"task_seq_per_s_flight_{key}"] = median(
+            [t["task_seq_per_s"] for t in trials[setting]])
+        results[f"record_ns_flight_{key}"] = median(
+            [t["record_ns"] for t in trials[setting]])
+    on = results["task_seq_per_s_flight_on"]
+    off = results["task_seq_per_s_flight_off"]
+    results["overhead_pct"] = round((off - on) / off * 100.0, 2)
+    results["trials_per_setting"] = len(trials["1"])
+    # Same noise floor as the other observability A/Bs: sequential task
+    # latency on a shared host jitters ~±10%; the recorder stays
+    # default-on while inside it.
+    results["within_noise"] = abs(results["overhead_pct"]) <= 10.0
+    out = out or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_obs_r03.json")
+    with open(out, "w") as f:
+        json.dump({"results": results}, f, indent=1)
+    print(json.dumps({"metric": "flight_overhead", **results}))
+
+
 def run_stub_daemon(gcs_address: str, num_cpus: int) -> None:
     """Bench stub node daemon (own process): the daemon's lease surface
     with REAL block accounting (LocalLeaseTable) but fake worker processes
@@ -787,6 +880,14 @@ if __name__ == "__main__":
                         == "1")
     elif "--trace-overhead" in sys.argv:
         run_trace_overhead()
+    elif "--flight-child" in sys.argv:
+        run_flight_child(sys.argv[sys.argv.index("--flight-child") + 1]
+                         == "1", quick="--quick" in sys.argv)
+    elif "--flight-overhead" in sys.argv:
+        run_flight_overhead(
+            quick="--quick" in sys.argv,
+            out=(sys.argv[sys.argv.index("--out") + 1]
+                 if "--out" in sys.argv else None))
     elif "--stub-daemon" in sys.argv:
         i = sys.argv.index("--stub-daemon")
         run_stub_daemon(sys.argv[i + 1], int(sys.argv[i + 2]))

@@ -366,6 +366,21 @@ class Config:
     # retrieval without scanning the 100k-event ring). Oldest traces are
     # evicted first; events older than the ring's base are pruned lazily.
     trace_max_traces = _Flag(2048)
+    # Per-process black-box flight recorder (util.flightrec): every process
+    # mmaps a bounded ring file under the session dir and appends compact
+    # binary events at state transitions (task/actor edges, RPC connect/fail,
+    # lease carve/revoke, channel stall, serve shed, collective enter/exit).
+    # The mmap survives SIGKILL, so `ray-tpu debug` reads it postmortem.
+    # Off = every record site costs one None check.
+    flightrec_enabled = _Flag(True)
+    # Flight-recorder ring size per process, KiB. 128-byte fixed slots:
+    # the default 256 KiB keeps the last ~2k events per process.
+    flightrec_ring_kb = _Flag(256)
+    # Health watchdog (core.health, runs inside the GCS health loop):
+    # a node whose heartbeat (or a component whose metrics report) is older
+    # than `stall_factor` periods — but younger than the death bound — is
+    # classified `stalled` (SIGSTOP/deadlock posture) instead of `healthy`.
+    health_stall_factor = _Flag(2.5)
 
     # -- debugging ------------------------------------------------------------
     # Opt-in runtime lock-order validator (ray_tpu.devtools.lockcheck):

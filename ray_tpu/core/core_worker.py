@@ -782,6 +782,12 @@ class CoreWorker:
         self.gcs_address = gcs_address
         self.mode = mode
         self.namespace = namespace
+        if mode == "driver":
+            # Workers init in worker_main (before CoreWorker); the
+            # cluster-attached driver gets its ring here.
+            from ray_tpu.util import flightrec
+
+            flightrec.init("driver")
         self._gcs_rpc = RpcClient(gcs_address)
         self.gcs = _GcsClientAdapter(self._gcs_rpc)
         self.scheduler = _SchedulerProxy(self._gcs_rpc)
@@ -3340,12 +3346,15 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
-        from ray_tpu.util import tracing
+        from ray_tpu.util import flightrec, tracing
 
         try:
             tracing.flush(self)
         except Exception:  # noqa: BLE001 — shutdown is best-effort
             log_swallowed(logger, "trace flush at shutdown")
+        if self.mode == "driver":
+            # Workers detach their ring in worker_main's exit hooks.
+            flightrec.close()
         self._metrics_exporter.stop()
         # Abort the log-mirror's parked long-poll (closing the client
         # errors the in-flight call) and join the thread.

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core.config import Config, config, set_config
 from ray_tpu.core.gcs import ActorInfo, GlobalControlStore, JobInfo, NodeInfo
 from ray_tpu.core.gcs_shards import ShardedObjectDirectory, ShardedPubSub
+from ray_tpu.core.health import HealthWatchdog
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.core.ingest import ObservabilityIngest
 from ray_tpu.core.resources import NodeResources, ResourceSet
@@ -45,6 +46,7 @@ from ray_tpu.core.task_spec import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("gcs_server")
@@ -185,6 +187,14 @@ class GcsService:
             # store role Redis plays in the reference,
             # ``gcs_server.cc:523-524``).
             self._restore_from_mirror(restore_from)
+        # Watchdog: classifies nodes (heartbeat age) and components
+        # (metrics-report age) healthy/stalled/dead each health tick;
+        # transitions land on the ingest plane + the flight recorder and
+        # the states export as ray_tpu_component_health.
+        self._watchdog = HealthWatchdog(
+            on_transition=self._on_health_transition)
+        self._ingest_drop_warned = False
+        self._ingest_dropped_last = 0
         self._monitor = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
@@ -282,6 +292,45 @@ class GcsService:
                 logger.warning("node %s missed %d heartbeats — marking dead",
                                node_id.hex()[:8], threshold)
                 self._handle_node_death(node_id)
+            try:
+                self._watchdog_tick(now)
+            except Exception:  # noqa: BLE001 — diagnostics never kill health
+                log_swallowed(logger, "watchdog tick")
+
+    def _watchdog_tick(self, now: float) -> None:
+        cfg = config()
+        period = cfg.health_check_period_s
+        interval = cfg.metrics_export_interval_s
+        factor = cfg.health_stall_factor
+        with self._lock:
+            node_ages = {nid.hex(): now - last
+                         for nid, last in self._heartbeats.items()}
+            dead_hexes = {nid.hex() for nid in self._dead_nodes}
+        self._watchdog.tick(
+            node_ages=node_ages, dead_nodes=dead_hexes,
+            components=self.store.metrics.process_meta(),
+            node_bounds=(period * factor,
+                         period * cfg.health_check_failure_threshold),
+            # component dead bound = the aggregator's own staleness horizon,
+            # so "report aged out" and "report evicted" classify the same.
+            comp_bounds=(interval * factor, max(5.0, 3.0 * interval)),
+            now=now)
+
+    def _on_health_transition(self, tr: dict) -> None:
+        subject = ":".join(str(p) for p in tr["key"][1:])
+        logger.warning("watchdog: %s %s %s -> %s",
+                       tr["kind"], subject, tr["old"], tr["new"])
+        flightrec.record("health", subject, f"{tr['old']}->{tr['new']}")
+        self.record_task_event({
+            "type": "health_transition", "kind": tr["kind"],
+            "subject": subject, "old": tr["old"], "new": tr["new"],
+            "time": tr["time"], "beacon_ts": tr.get("beacon_ts"),
+        })
+
+    def health_states(self) -> List[dict]:
+        """Watchdog view: every tracked node/component with its current
+        healthy/stalled/dead classification (ray-tpu status / debug)."""
+        return self._watchdog.states()
 
     def _handle_node_death(self, node_id: NodeID) -> None:
         with self._lock:
@@ -290,6 +339,7 @@ class GcsService:
             addr = self._node_addr.pop(node_id)
             self._dead_nodes.add(node_id)
             self._heartbeats.pop(node_id, None)
+            flightrec.record("health", node_id.hex()[:16], "node dead")
             self.store.mark_node_dead(node_id)
             self.scheduler.remove_node(node_id)
             self._daemons.invalidate(addr)
@@ -601,6 +651,8 @@ class GcsService:
         block_id = f"cap-{self._next_block}"
         self._blocks[block_id] = _CapacityBlock(
             block_id, node_id, request, granted, client_id=client_id)
+        flightrec.record("lease", block_id,
+                         f"block grant x{granted} -> {node_id.hex()[:8]}")
         return block_id, node_id, self._node_addr[node_id], granted
 
     def return_block_capacity(self, block_id: str, n: int) -> bool:
@@ -681,6 +733,7 @@ class GcsService:
         lease_id = f"lease-{self._next_lease}"
         self._leases[lease_id] = _Lease(lease_id, node_id, request, pg_id,
                                         bundle_index, client_id=client_id)
+        flightrec.record("lease", lease_id, f"grant -> {node_id.hex()[:8]}")
         return lease_id, node_id, self._node_addr[node_id]
 
     def on_client_opened(self, client_id: str) -> None:
@@ -714,9 +767,13 @@ class GcsService:
                 if addr is not None:
                     revoked.append((block_id, addr))
             self._wake_all_locked()  # wake its blocked requesters
+        flightrec.record("lease", client_id[:32],
+                         f"client death: {len(orphaned)} leases "
+                         f"{len(revoked)} blocks")
         for block_id, addr in revoked:
             logger.info("revoking capacity block %s after client death",
                         block_id)
+            flightrec.record("lease", block_id, "revoke (client death)")
             try:
                 self._daemons.get(addr).notify("revoke_capacity_block",
                                                block_id)
@@ -731,6 +788,7 @@ class GcsService:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 return
+            flightrec.record("lease", lease_id, "release")
             if lease.pg_id is not None:
                 pg = self._pgs.get(lease.pg_id)
                 if pg is not None and 0 <= lease.bundle_index < len(pg.bundles):
@@ -1243,7 +1301,7 @@ class GcsService:
 
     def _collect_gcs_metrics(self) -> None:
         """Control-plane gauges: scheduler queue depth + lease/node counts."""
-        from ray_tpu.core.metrics_export import mirror_stats_gauge
+        from ray_tpu.core.metrics_export import counter, mirror_stats_gauge
 
         with self._demand_lock:
             pending = len(self._demand_list)
@@ -1256,10 +1314,27 @@ class GcsService:
             ing = self._ingest.stats()
             st["ingest_queued"] = ing["queued"]
             st["ingest_dropped"] = ing["dropped"]
+            # Surface loss, don't just count it: a monotonic counter the
+            # dashboard/alerting can rate(), plus one warn line on the
+            # first drop ever (silent loss is how observability gaps hide).
+            delta = ing["dropped"] - self._ingest_dropped_last
+            if delta > 0:
+                self._ingest_dropped_last = ing["dropped"]
+                counter("ray_tpu_ingest_dropped_total",
+                        "Observability reports dropped by the GCS ingest "
+                        "staging queue (overflow backpressure)").inc(delta)
+                if not self._ingest_drop_warned:
+                    self._ingest_drop_warned = True
+                    logger.warning(
+                        "observability ingest dropped %d report(s) — "
+                        "staging queue overflow (gcs_ingest_queue_max=%d); "
+                        "metrics/trace data is now lossy",
+                        ing["dropped"], config().gcs_ingest_queue_max)
         mirror_stats_gauge(
             "ray_tpu_gcs_sched",
             "GCS scheduler state (pending demands, live leases, capacity "
             "blocks, alive nodes, ingest queue)", st)
+        self._watchdog.export_gauge()
 
     # ====================== pubsub (long-poll) ======================
 
@@ -1487,14 +1562,26 @@ def main(argv=None) -> int:
                              "(head-disk-loss recovery)")
     args = parser.parse_args(argv)
     set_config(Config())
+    flightrec.init("gcs")
     service, server = serve(args.port, args.host, args.snapshot,
                             args.restore_from)
     print(f"GCS_ADDRESS={server.address}", flush=True)
 
     stop = threading.Event()
 
-    def handle(sig, frame):
+    def _flush_tails():
+        # Orderly deaths lose zero buffered observability: drain the
+        # ingest staging queue and detach the flight-recorder ring
+        # (SIGKILL is what the mmap'd ring itself is for).
         service.shutdown()
+        flightrec.close()
+
+    import atexit
+
+    atexit.register(_flush_tails)
+
+    def handle(sig, frame):
+        _flush_tails()
         stop.set()
 
     signal.signal(signal.SIGTERM, handle)

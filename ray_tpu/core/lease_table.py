@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.util import flightrec
+
 # Capacity-block lease ids are namespaced so every release path can route a
 # lease to the right authority: "lease-N" → GCS release_lease, "cap-N#k" →
 # daemon-local LocalLeaseTable.release.
@@ -63,6 +65,7 @@ class LocalLeaseTable:
             if block_id in self._blocks:
                 return
             self._blocks[block_id] = _BlockState(block_id, shape, total)
+        flightrec.record("lease", block_id, f"adopt x{int(total)}")
 
     def carve(self, block_id: str, shape: Optional[Dict[str, float]] = None,
               total: Optional[int] = None) -> Optional[str]:
@@ -81,7 +84,8 @@ class LocalLeaseTable:
             st.next_seq += 1
             st.in_use.add(lease_id)
             st.last_activity = time.monotonic()
-            return lease_id
+        flightrec.record("lease", lease_id, f"carve free={st.free}")
+        return lease_id
 
     def release(self, lease_id: str) -> bool:
         """Return a carved lease's unit to its block's free pool. Revoked
@@ -97,7 +101,8 @@ class LocalLeaseTable:
                 st.last_activity = time.monotonic()
             elif not st.in_use:
                 self._blocks.pop(st.block_id, None)
-            return True
+        flightrec.record("lease", lease_id, "release")
+        return True
 
     def revoke(self, block_id: str) -> None:
         """GCS reclaim: stop carving and drop the free pool NOW; in-use
@@ -110,6 +115,7 @@ class LocalLeaseTable:
             st.free = 0
             if not st.in_use:
                 self._blocks.pop(block_id, None)
+        flightrec.record("lease", block_id, "revoke")
 
     def sweep_idle(self, ttl_s: float) -> List[Tuple[str, int]]:
         """Remove and return ``(block_id, n_free)`` for blocks whose free

@@ -32,6 +32,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ray_tpu.core.config import config
+from ray_tpu.util import flightrec
 from ray_tpu.util import metrics as um
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
@@ -69,6 +70,12 @@ def _metric(cls, name: str, desc: str = "", **kwargs) -> um.Metric:
 def gauge(name: str, desc: str = "", tag_keys=()) -> um.Gauge:
     """Cached process-wide Gauge — for collectors mirroring ad-hoc stats."""
     return _metric(um.Gauge, name, desc, tag_keys=tag_keys)
+
+
+def counter(name: str, desc: str = "", tag_keys=()) -> um.Counter:
+    """Cached process-wide Counter — for collectors mirroring monotonic
+    ad-hoc totals (inc by positive delta only)."""
+    return _metric(um.Counter, name, desc, tag_keys=tag_keys)
 
 
 def mirror_stats_gauge(name: str, desc: str, stats: Dict[str, float]) -> None:
@@ -130,6 +137,7 @@ def serve_shed_total() -> um.Counter:
 
 def observe_shed(deployment: str, reason: str) -> None:
     """Count one shed request (router/handle/engine Saturated raises)."""
+    flightrec.record("serve", deployment, f"shed {reason}")
     if metrics_enabled():
         serve_shed_total().inc(1, {"deployment": deployment,
                                    "reason": reason})

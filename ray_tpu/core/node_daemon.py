@@ -38,6 +38,7 @@ from ray_tpu.core.rpc import (
     RpcConnectionError,
     RpcServer,
 )
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("node_daemon")
@@ -624,6 +625,8 @@ class NodeDaemon:
         log_file.close()  # the child holds its own fd
         worker = _Worker(worker_id, proc, env_key=env_key)
         self._workers[worker_id] = worker
+        flightrec.record("process", f"worker-{worker_id.hex()[:12]}",
+                         f"spawn pid={proc.pid}")
         return worker
 
     def _spawn_dedicated(self, runtime_env: Dict[str, Any],
@@ -775,6 +778,9 @@ class NodeDaemon:
                     self._pool_cv.notify_all()
             for worker in dead:
                 rc = worker.proc.returncode
+                flightrec.record(
+                    "process", f"worker-{worker.worker_id.hex()[:12]}",
+                    f"exit rc={rc} pid={worker.proc.pid}")
                 with self._pool_lock:
                     orphan_lease = self._worker_lease.pop(worker.worker_id, None)
                 if orphan_lease is not None:
@@ -1617,6 +1623,9 @@ def main(argv=None) -> int:
     resources = json.loads(args.resources)
     if "CPU" not in resources:
         resources["CPU"] = float(os.cpu_count() or 4)
+    from ray_tpu.util import flightrec
+
+    flightrec.init("node_daemon")
     daemon = NodeDaemon(args.gcs, resources, json.loads(args.labels),
                         host=args.host)
     print(f"NODE_ADDRESS={daemon.address}", flush=True)
@@ -1625,8 +1634,21 @@ def main(argv=None) -> int:
 
     stop = threading.Event()
 
-    def handle(sig, frame):
+    def _flush_tails():
+        # Orderly deaths lose zero buffered observability (SIGKILL losses
+        # are what the mmap'd flight-recorder ring is for).
         daemon.shutdown()
+        from ray_tpu.util import tracing
+
+        tracing.flush()
+        flightrec.close()
+
+    import atexit
+
+    atexit.register(_flush_tails)
+
+    def handle(sig, frame):
+        _flush_tails()
         stop.set()
 
     signal.signal(signal.SIGTERM, handle)

@@ -70,6 +70,7 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc")
@@ -872,6 +873,7 @@ class RpcClient:
             sock = socket.create_connection((host, int(port)),
                                             timeout=self._timeout)
         except OSError as e:
+            flightrec.record("rpc", self.address, f"connect fail: {e}")
             raise RpcConnectionError(
                 f"cannot connect to {self.address}: {e}"
             ) from e
@@ -918,6 +920,7 @@ class RpcClient:
                 target=self._read_loop, args=(sock,),
                 name=f"rpc-read-{self.address}", daemon=True,
             ).start()
+            flightrec.record("rpc", self.address, "connected")
             return sock
 
     def _resolve_dest(self, req_id: int, size: int):
@@ -963,6 +966,11 @@ class RpcClient:
 
     def _fail_all(self, error: Exception) -> None:
         with self._state_lock:
+            if self._pending and not self._closed:
+                # Only meaningful losses (in-flight calls failed), not
+                # plain close() teardown — the ring is for postmortems.
+                flightrec.record("rpc", self.address,
+                                 f"lost {len(self._pending)} in-flight")
             pending, self._pending = self._pending, {}
             self._pending_dest.clear()
             self._sent_templates = set()

@@ -61,6 +61,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
     TaskType,
 )
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("runtime")
@@ -362,6 +363,7 @@ class Runtime:
         labels: Dict[str, str] | None = None,
     ):
         set_config(Config(system_config))
+        flightrec.init("driver")
         self.namespace = namespace
         self.gcs = GlobalControlStore()
         self.store = MemoryStore()
@@ -779,6 +781,8 @@ class Runtime:
         self._ctx.held_node = node.node_id
         started = time.time()
         trace_id, span_id, parent_span = self._adopt_trace(spec)
+        flightrec.record("task", spec.task_id.hex()[:16],
+                         f"start {spec.function_name[:40]} trace={trace_id}")
         # Lifecycle phase stamps (same split as the multiprocess worker's
         # execute loop): submit→dispatch, dep fetch, user-code runtime.
         phases = ({"queued": max(0.0, started - spec.submit_ts)}
@@ -820,6 +824,10 @@ class Runtime:
         finally:
             from ray_tpu.util import tracing
 
+            flightrec.record(
+                "task", spec.task_id.hex()[:16],
+                f"{'FAIL' if failure is not None else 'finish'} "
+                f"trace={trace_id}")
             tracing.set_context(None)
             self._ctx.in_worker = False
             self._ctx.task_state = None
@@ -1351,6 +1359,7 @@ class Runtime:
         from ray_tpu.util import tracing
 
         tracing.flush(self)
+        flightrec.close()
         self._metrics_exporter.stop()
         from ray_tpu.util.state import _reset_task_cache
 

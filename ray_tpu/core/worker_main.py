@@ -32,6 +32,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.core.task_spec import (DAG_LOOP_METHOD, SpecTemplateStore,
                                     TaskSpec)
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("worker")
@@ -248,6 +249,8 @@ class WorkerService:
         sampled = (bool(spec.trace_ctx[2])
                    if spec.trace_ctx and len(spec.trace_ctx) > 2 else True)
         tracing.set_context((trace_id, span_id, sampled))
+        flightrec.record("task", spec.task_id.hex()[:16],
+                         f"start {spec.function_name[:40]} trace={trace_id}")
         return (trace_id, span_id, parent, time.time())
 
     def _end_trace(self, spec: TaskSpec, trace: tuple, ok: bool,
@@ -278,6 +281,8 @@ class WorkerService:
         if phases:
             event["phases"] = {k: round(v, 6) for k, v in phases.items()}
             observe_task_phases(phases, ok=ok)
+        flightrec.record("task", spec.task_id.hex()[:16],
+                         f"{'finish' if ok else 'FAIL'} trace={trace_id}")
         self._events.record(event)
 
     def register_spec_template(self, digest: bytes, blob: bytes) -> None:
@@ -589,6 +594,8 @@ class WorkerService:
                             spec.options.max_concurrency)
         with self._actors_lock:
             self._actors[spec.actor_id] = state
+        flightrec.record("actor", spec.actor_id.hex()[:16],
+                         f"start {spec.function_name[:40]}")
         logger.info("actor %s (%s) started in pid %d",
                     spec.actor_id.hex()[:8], spec.function_name, os.getpid())
         return True
@@ -870,6 +877,7 @@ def main() -> int:
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     store_name = os.environ.get("RAY_TPU_STORE_NAME", "")
 
+    flightrec.init("worker")
     core = CoreWorker(
         gcs_address,
         node_id=node_id,
@@ -886,6 +894,37 @@ def main() -> int:
     service = WorkerService(core, worker_id=worker_id, daemon_client=daemon)
     server = RpcServer(service, name=f"worker-{worker_id.hex()[:8]}")
     daemon.call("register_worker", worker_id, server.address)
+
+    # Crash-flush: orderly deaths (SIGTERM from the daemon, atexit) lose
+    # zero buffered task events / spans — SIGKILL is what the mmap'd
+    # flight-recorder ring is for.
+    import atexit
+    import signal as _signal
+
+    def _flush_tails():
+        from ray_tpu.util import tracing
+
+        try:
+            service._events.flush()
+        except Exception:  # noqa: BLE001 — flush-on-death is best-effort
+            pass
+        try:
+            tracing.flush(core)
+        except Exception:  # noqa: BLE001
+            pass
+        flightrec.close()
+
+    atexit.register(_flush_tails)
+
+    def _fatal(sig, frame):
+        _flush_tails()
+        os._exit(0)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _fatal)
+        _signal.signal(_signal.SIGINT, _fatal)
+    except ValueError:  # non-main thread (embedded use)
+        pass
 
     # Watchdog: the daemon is this process's reason to live. If it goes away
     # (kill -9, node death), exit so no orphan workers accumulate — the

@@ -37,8 +37,18 @@ import uuid
 from typing import Any, Optional, Tuple
 
 from ray_tpu.core import serialization
+from ray_tpu.util import flightrec
 
 _FILE_HEADER = struct.Struct("<Q")  # nslots
+
+
+def _stall_after_s() -> float:
+    """How long a channel wait may block before the flight recorder calls
+    it a stall — a quarter of the internal wait budget, so the ring names
+    a wedged stage well before the ChannelTimeout fires."""
+    from ray_tpu.core.config import config
+
+    return max(0.05, float(config().internal_wait_timeout_s) / 4.0)
 _SLOT_HEADER = struct.Struct("<QQQ")  # write_seq, ack_seq, payload_len
 FILE_HEADER_SIZE = _FILE_HEADER.size
 SLOT_HEADER_SIZE = _SLOT_HEADER.size
@@ -120,6 +130,8 @@ class Channel:
         self._rcursor = 0
         # Last write_seq consumed per slot (reader-private).
         self._read_seq = [0] * self.slots
+        flightrec.record("channel", self.name[:32],
+                         "create" if created else "attach")
 
     # -- header accessors -----------------------------------------------------
 
@@ -156,21 +168,33 @@ class Channel:
         callers (DeviceChannel) can land payload bytes DIRECTLY in the shm
         region — ``self._wpayload_off`` — between this and ``_publish``,
         no intermediate buffer."""
+        started = time.monotonic()
         deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+                    else started + timeout)
+        stall_at = started + _stall_after_s()
+        stalled = False
         slot = self._wcursor % self.slots
         spins = 0
         while True:
             write_seq, ack_seq, _ = self._load(slot)
             if write_seq % 2 == 0 and ack_seq == write_seq:
                 break  # slot's previous value consumed (or slot fresh)
-            if deadline is not None and time.monotonic() > deadline:
+            now = time.monotonic()
+            if deadline is not None and now > deadline:
                 raise ChannelTimeout(
                     f"writer blocked on full ring in {self.name} "
                     f"(slot {slot}/{self.slots})")
+            if not stalled and now > stall_at:
+                stalled = True
+                flightrec.record("channel", self.name[:32],
+                                 f"write stall slot={slot}")
             spins += 1
             if spins > self._tight_spins:
                 self._sleep_poll(spins)
+        if stalled:
+            flightrec.record(
+                "channel", self.name[:32],
+                f"write resume after {time.monotonic() - started:.1f}s")
         self._store_write_seq(slot, write_seq + 1)  # mark in-progress (odd)
         self._pending_write_seq = write_seq
         self._wslot = slot
@@ -205,19 +229,32 @@ class Channel:
         the slot before our ack) until the caller's ``_ack_current``. The
         zero-copy read half of the DeviceChannel protocol. Idempotent until
         acked, which is what lets ``read()`` retry a torn copy."""
+        started = time.monotonic()
         deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+                    else started + timeout)
+        stall_at = started + _stall_after_s()
+        stalled = False
         slot = self._rcursor % self.slots
         spins = 0
         while True:
             write_seq, _ack, length = self._load(slot)
             if write_seq % 2 == 0 and write_seq > self._read_seq[slot]:
+                if stalled:
+                    flightrec.record(
+                        "channel", self.name[:32],
+                        f"read resume after "
+                        f"{time.monotonic() - started:.1f}s")
                 self._pending_read_seq = write_seq
                 self._rslot = slot
                 off = self._slot_off(slot) + SLOT_HEADER_SIZE
                 return memoryview(self._mm)[off:off + length], length
-            if deadline is not None and time.monotonic() > deadline:
+            now = time.monotonic()
+            if deadline is not None and now > deadline:
                 raise ChannelTimeout(f"no value arrived in {self.name}")
+            if not stalled and now > stall_at:
+                stalled = True
+                flightrec.record("channel", self.name[:32],
+                                 f"read stall slot={slot}")
             spins += 1
             if spins > self._tight_spins:
                 self._sleep_poll(spins)

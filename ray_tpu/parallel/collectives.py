@@ -37,6 +37,7 @@ import numpy as np
 
 from ray_tpu.core.config import config as _get_config
 from ray_tpu.core.runtime import get_runtime
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 
 logger = get_logger("collectives")
@@ -1484,19 +1485,39 @@ def _prep(state, tensor):
     return _to_numpy(tensor)
 
 
+def _traced_op(op: str, group: str, rank: int, call):
+    """Flight-record the enter/exit edges of one collective op — a rank
+    that dies inside the rendezvous leaves an unmatched ``enter`` in its
+    ring, which is exactly what the postmortem needs to name the straggler
+    that hung the group."""
+    flightrec.record("collective", group[:32], f"enter {op} rank={rank}")
+    try:
+        result = call()
+    except BaseException as e:
+        flightrec.record("collective", group[:32],
+                         f"FAIL {op} rank={rank}: {type(e).__name__}")
+        raise
+    flightrec.record("collective", group[:32], f"exit {op} rank={rank}")
+    return result
+
+
 def allreduce(tensor, op: str = "sum", group_name: str = "default"):
     """reference: collective.py:258."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"unknown reduce op {op}")
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange_desc(rank, ("allreduce", op), _prep(state, tensor))
+    return _traced_op("allreduce", group_name, rank, lambda: state.
+                      exchange_desc(rank, ("allreduce", op),
+                                    _prep(state, tensor)))
 
 
 def barrier(group_name: str = "default") -> None:
     """reference: collective.py:298."""
     state = _group(group_name)
-    state.exchange_desc(get_rank(group_name), ("barrier",), None)
+    rank = get_rank(group_name)
+    _traced_op("barrier", group_name, rank,
+               lambda: state.exchange_desc(rank, ("barrier",), None))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
@@ -1504,14 +1525,17 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     state = _group(group_name)
     rank = get_rank(group_name)
     value = _prep(state, tensor) if rank == src_rank else None
-    return state.exchange_desc(rank, ("broadcast", src_rank), value)
+    return _traced_op("broadcast", group_name, rank, lambda: state.
+                      exchange_desc(rank, ("broadcast", src_rank), value))
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     """reference: collective.py:423. Returns list of per-rank tensors."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange_desc(rank, ("allgather",), _prep(state, tensor))
+    return _traced_op("allgather", group_name, rank, lambda: state.
+                      exchange_desc(rank, ("allgather",),
+                                    _prep(state, tensor)))
 
 
 def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
@@ -1521,7 +1545,9 @@ def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
         raise ValueError(f"unknown reduce op {op}")
     state = _group(group_name)
     rank = get_rank(group_name)
-    shards = state.exchange_desc(rank, ("reducescatter", op), _to_numpy(tensor))
+    shards = _traced_op("reducescatter", group_name, rank, lambda: state.
+                        exchange_desc(rank, ("reducescatter", op),
+                                      _to_numpy(tensor)))
     return shards[rank]
 
 
@@ -1532,14 +1558,17 @@ def alltoall(tensor, group_name: str = "default"):
     """
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.exchange_desc(rank, ("alltoall",), _to_numpy(tensor))[rank]
+    return _traced_op("alltoall", group_name, rank, lambda: state.
+                      exchange_desc(rank, ("alltoall",),
+                                    _to_numpy(tensor)))[rank]
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     """reference: collective.py:531 (p2p)."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    state.p2p_send(rank, dst_rank, _to_numpy(tensor))
+    _traced_op("send", group_name, rank,
+               lambda: state.p2p_send(rank, dst_rank, _to_numpy(tensor)))
 
 
 def recv(src_rank: int, group_name: str = "default",
@@ -1548,4 +1577,5 @@ def recv(src_rank: int, group_name: str = "default",
     group's ``collective_timeout_s``."""
     state = _group(group_name)
     rank = get_rank(group_name)
-    return state.p2p_recv(src_rank, rank, timeout)
+    return _traced_op("recv", group_name, rank,
+                      lambda: state.p2p_recv(src_rank, rank, timeout))

@@ -22,12 +22,114 @@ def _init_from_args(args) -> None:
     )
 
 
+def _node_hex(node_id) -> str:
+    return node_id.hex() if hasattr(node_id, "hex") else str(node_id)
+
+
+def format_status(nodes, health, series, ingest) -> str:
+    """One-screen cluster view from the existing metrics rollup — no new
+    RPCs, just the exposition + the watchdog's states rendered together."""
+    from ray_tpu.devtools import postmortem
+
+    lines = ["== nodes =="]
+    for n in nodes:
+        res = " ".join(f"{k}={v:g}" for k, v in sorted(
+            (n.get("resources") or {}).items()))
+        lines.append(f"  {_node_hex(n['node_id'])[:12]:<14} "
+                     f"{'alive' if n.get('alive') else 'DEAD':<6} "
+                     f"{n.get('address', '')}  {res}")
+    lines.append("")
+    lines.append("== component health ==")
+    if not health:
+        lines.append("  (watchdog has no subjects yet)")
+    for s in health:
+        key = s.get("key") or ()
+        subject = ":".join(str(k) for k in key[1:])
+        beacon = (f"  last ring write {s['beacon_ts']:.0f}"
+                  if s.get("beacon_ts") else "")
+        lines.append(f"  {s.get('kind', '?'):<10} {subject:<40} "
+                     f"{str(s.get('state', '?')).upper()}{beacon}")
+    sched = {s["tags"].get("counter"): s["value"]
+             for s in postmortem.select(series, "ray_tpu_gcs_sched")}
+    lines.append("")
+    lines.append("== scheduler ==")
+    for key in ("pending_demands", "leases", "capacity_blocks",
+                "alive_nodes", "ingest_queued"):
+        if key in sched:
+            lines.append(f"  {key:<18}{sched[key]:g}")
+    serve_names = sorted({s["name"] for s in series
+                          if s["name"].startswith(("ray_tpu_serve",
+                                                   "ray_tpu_llm",
+                                                   "ray_tpu_paged",
+                                                   "ray_tpu_kv"))})
+    if serve_names:
+        lines.append("")
+        lines.append("== serve ==")
+        for name in serve_names:
+            if name.endswith(("_bucket", "_sum")):
+                continue  # histogram internals; _count carries the rate
+            total = sum(s["value"] for s in series if s["name"] == name)
+            lines.append(f"  {name:<36}{total:g}")
+    lines.append("")
+    lines.append("== observability ingest ==")
+    lines.append(f"  queued={ingest.get('queued', 0)} "
+                 f"dropped={ingest.get('dropped', 0)} "
+                 f"drained={ingest.get('drained', 0)}")
+    return "\n".join(lines)
+
+
 def cmd_status(args) -> int:
+    if getattr(args, "gcs", None):
+        # One-shot against a live cluster: everything below is served from
+        # state the GCS already maintains for the dashboard.
+        from ray_tpu.core.rpc import RpcClient
+        from ray_tpu.devtools import postmortem
+
+        client = RpcClient(args.gcs)
+        try:
+            nodes = client.call("list_nodes")
+            health = client.call("health_states")
+            series = postmortem.parse_prometheus(client.call("metrics_text"))
+            ingest = client.call("ingest_stats")
+        finally:
+            client.close()
+        if getattr(args, "json", False):
+            print(json.dumps(
+                {"nodes": nodes, "health": health, "series": series,
+                 "ingest": ingest}, indent=2, default=str))
+        else:
+            print(format_status(nodes, health, series, ingest))
+        return 0
+
     from ray_tpu.util import state
 
     _init_from_args(args)
     print(json.dumps(state.cluster_summary(), indent=2, default=str))
     return 0
+
+
+def cmd_debug(args) -> int:
+    from ray_tpu.devtools import postmortem
+
+    gcs_events = None
+    health = None
+    if getattr(args, "gcs", None):
+        from ray_tpu.core.rpc import RpcClient
+
+        client = RpcClient(args.gcs)
+        try:
+            gcs_events = client.call("task_events")
+            health = client.call("health_states")
+        finally:
+            client.close()
+    timeline = postmortem.build_timeline(
+        session_dir=args.session, gcs_events=gcs_events,
+        health_states=health)
+    if getattr(args, "json", False):
+        print(json.dumps(timeline, indent=2, default=str))
+    else:
+        print(postmortem.format_timeline(timeline, last_n=args.last))
+    return 0 if timeline["processes"] else 1
 
 
 def cmd_list(args) -> int:
@@ -149,7 +251,24 @@ def main(argv=None) -> int:
     parser.add_argument("--num-nodes", type=int, default=1)
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("status", help="cluster summary")
+    p_status = sub.add_parser("status", help="cluster summary")
+    p_status.add_argument("--gcs", default=None, metavar="ADDR",
+                          help="attach to a live cluster's GCS "
+                               "(host:port) instead of starting one")
+    p_status.add_argument("--json", action="store_true",
+                          help="raw rollup instead of the rendered view")
+
+    p_dbg = sub.add_parser(
+        "debug", help="postmortem timeline from flight-recorder rings")
+    p_dbg.add_argument("--session", default=None, metavar="DIR",
+                       help="session dir holding *.ring files "
+                            "(default: $RAY_TPU_SESSION_DIR)")
+    p_dbg.add_argument("--gcs", default=None, metavar="ADDR",
+                       help="also merge the GCS task-event/health tables")
+    p_dbg.add_argument("--last", type=int, default=25,
+                       help="events shown per timeline section")
+    p_dbg.add_argument("--json", action="store_true",
+                       help="machine-readable timeline")
 
     p_list = sub.add_parser("list", help="list cluster state")
     p_list.add_argument(
@@ -180,6 +299,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "trace": cmd_trace,
         "bench": cmd_bench,
+        "debug": cmd_debug,
     }[args.cmd](args)
 
 

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.config import config
+from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
 from ray_tpu.serve.autoscaling import (DeploymentSignals, SLOPolicy,
                                        TTFTRollup)
@@ -371,6 +372,8 @@ class ServeControllerActor:
         # replacements — death during scale-up still converges to target.
         if self._dead:
             dead, self._dead = self._dead, set()
+            for key in dead:
+                flightrec.record("serve", key[:16], "replica dead")
             for name in list(self._replicas):
                 kept = [(v, r) for v, r in self._replicas[name]
                         if r.actor_id.hex() not in dead]

@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import flightrec
 
 
 class DeploymentResponse:
@@ -502,6 +503,10 @@ class DeploymentHandle:
             if req_ctx is not None:
                 tracing.set_context(req_ctx)
             replica, key = self._router._pick(model_id, prefix_hash)
+            flightrec.record(
+                "serve", self._name[:32],
+                f"admit -> {key[:12]}"
+                + (f" trace={req_ctx[0]}" if req_ctx is not None else ""))
             if sampled:
                 self._emit_pick_span(req_ctx, key, time.monotonic() - t0)
                 kwargs["_trace_submit_ts"] = time.time()

@@ -150,6 +150,13 @@ class Gauge(Metric):
         with self._lock:
             return self._values.get(self._tag_tuple(tags), 0.0)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one tagged series (a gauge for a retired subject — e.g. a
+        pruned dead component — must disappear, not freeze at its last
+        value)."""
+        with self._lock:
+            self._values.pop(self._tag_tuple(tags), None)
+
     def _prom_lines(self):
         out = [f"# TYPE {self._name} gauge"]
         with self._lock:
@@ -331,6 +338,27 @@ class MetricsAggregator:
                 self._reports.pop(key, None)
             return [(k, ts, snap) for k, (ts, snap)
                     in sorted(self._reports.items())]
+
+    _BEACON_METRIC = "ray_tpu_flightrec_last_write_ts"
+
+    def process_meta(self) -> List[Tuple[Tuple, float, Optional[float]]]:
+        """``[(key, report_ts, beacon_ts)]`` for every report STILL HELD —
+        including stale ones (no eviction on this read): the health
+        watchdog needs the last report time of a wedged process to age it
+        into ``stalled``/``dead``, which the evicting ``_live`` read would
+        erase. ``beacon_ts`` is the process's flight-recorder progress
+        beacon (last ring-write wall ts), None if it ships none."""
+        with self._lock:
+            items = list(self._reports.items())
+        out: List[Tuple[Tuple, float, Optional[float]]] = []
+        for key, (ts, snap) in items:
+            beacon = None
+            for m in snap:
+                if m.get("name") == self._BEACON_METRIC:
+                    for _tags, value in m.get("samples", ()):
+                        beacon = max(beacon or 0.0, float(value))
+            out.append((key, ts, beacon))
+        return out
 
     def prometheus_text(self, now: Optional[float] = None) -> str:
         """Merged cluster-wide exposition: every live process's series,

@@ -362,7 +362,15 @@ def test_slow_aggregator_cannot_stall_lease_grants():
                 assert elapsed < 2.0, (
                     f"lease grant took {elapsed:.2f}s behind telemetry")
                 lease.notify("release_lease", lease_id)
-                stats = lease.call("ingest_stats")
+                # notify() is fire-and-forget: on a loaded box the flood
+                # frames may still be in the conn loop when the grant
+                # returns — poll until the staging deque has seen them.
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    stats = lease.call("ingest_stats")
+                    if stats["submitted"] >= 12:
+                        break
+                    time.sleep(0.1)
                 assert stats["submitted"] >= 12
             finally:
                 flood.close()
