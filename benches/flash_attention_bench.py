@@ -8,18 +8,27 @@ results (B=4, H=12, D=64, bf16, causal):
     L=2048: flash fwd ~6.7ms  grad ~7.6ms  | dense fwd ~11.6ms grad ~15.4ms
     L=4096: flash fwd ~15.7ms grad ~20.7ms | dense fwd ~24.4ms grad ~51.9ms
 
-Prints one JSON line per sequence length.
+Also benches the paged-attention decode kernel (block-table-native, scalar
+prefetch) against the gather reference that materializes the whole
+``[S, max_len, H, D]`` cache per step — the serve-engine roofline story.
+
+Prints one JSON line per sequence length / pool geometry. ``--quick`` runs
+a single tiny geometry with 1 timed iteration as a CI smoke.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ray_tpu.ops.flash_attention import _dense_reference, flash_attention
+from ray_tpu.ops.paged_attention import (paged_attention,
+                                         paged_attention_reference)
 
 B, H, D = 4, 12, 64
 
@@ -34,7 +43,56 @@ def _bench(fn, *args, iters=20):
     return (time.perf_counter() - t0) / iters * 1e3
 
 
+def bench_paged(quick: bool) -> None:
+    """Paged decode attention: Pallas kernel vs gather reference.
+
+    On CPU the kernel runs in interpret mode — absolute numbers are
+    meaningless there (interpret is a correctness twin, not a perf path),
+    so the gather row is the one to read; on TPU both rows are compiled
+    and the speedup column is the roofline result.
+    """
+    on_tpu = jax.devices()[0].platform != "cpu"
+    geoms = [(4, 8, 16)] if quick else (
+        [(8, 16, 128), (16, 16, 128)] if on_tpu else [(4, 8, 32)])
+    for S, nb_seq, bt in geoms:
+        rng = np.random.default_rng(0)
+        pool = S * nb_seq + 1  # + trash block 0
+        q = jnp.asarray(rng.standard_normal((S, 1, H, D)), jnp.float32)
+        k_pool = jnp.asarray(rng.standard_normal((pool, bt, H, D)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((pool, bt, H, D)),
+                             jnp.float32)
+        tables = jnp.asarray(
+            np.arange(1, S * nb_seq + 1, dtype=np.int32).reshape(S, nb_seq))
+        lengths = jnp.asarray(
+            np.full((S,), nb_seq * bt - 1, dtype=np.int32))
+        kern = jax.jit(lambda *a: paged_attention(*a, interpret=not on_tpu))
+        ref = jax.jit(paged_attention_reference)
+        iters = 1 if quick else (20 if on_tpu else 3)
+        rec = {
+            "metric": f"paged_attention_s{S}_ctx{nb_seq * bt}",
+            "kernel_ms": round(_bench(kern, q, k_pool, v_pool, tables,
+                                      lengths, iters=iters), 2),
+            "gather_ms": round(_bench(ref, q, k_pool, v_pool, tables,
+                                      lengths, iters=iters), 2),
+            "kernel_mode": "pallas" if on_tpu else "interpret",
+            "platform": jax.devices()[0].platform,
+        }
+        if on_tpu:
+            rec["speedup"] = round(rec["gather_ms"] / rec["kernel_ms"], 2)
+        print(json.dumps(rec))
+
+
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="single tiny geometry, 1 iter — CI smoke")
+    ap.add_argument("--skip-flash", action="store_true",
+                    help="bench only the paged-attention rows")
+    args = ap.parse_args()
+    bench_paged(args.quick)
+    if args.skip_flash or args.quick:
+        return
     on_tpu = jax.devices()[0].platform != "cpu"
     seqs = (1024, 2048, 4096) if on_tpu else (256,)
     for L in seqs:

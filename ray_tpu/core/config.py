@@ -283,6 +283,29 @@ class Config:
     # deployment): bounds the GCS aggregator query rate from the serve
     # controller regardless of its reconcile cadence.
     serve_slo_rollup_interval_s = _Flag(1.0)
+    # Paged-attention implementation for the paged engine's decode/prefill
+    # forwards: "auto" picks the fused Pallas kernel on TPU (streams only a
+    # slot's live KV blocks through the block table — no [S, max_len, H, D]
+    # gather) and the XLA gather path on CPU; "pallas" / "interpret" /
+    # "gather" force a mode ("interpret" runs the same Pallas kernel in
+    # interpreter mode, the CPU-testable twin of the TPU path).
+    serve_paged_attention_kernel = _Flag("auto")
+    # Speculative decoding: how many draft-model tokens each slot proposes
+    # per scan step, all verified in ONE batched target forward. 0 disables
+    # speculation; > 0 requires a draft model (PagedLLMEngine draft_params/
+    # draft_config, or llm_deployment draft_params_fn). Acceptance is
+    # rejection-sampled so emitted tokens follow the TARGET distribution
+    # exactly (greedy output is token-identical to non-speculative greedy).
+    serve_spec_tokens = _Flag(0)
+    # Per-slot acceptance-rate floor: a slot whose acceptance EWMA sinks
+    # below this stops proposing for the rest of its request (one token per
+    # step, zero draft cost) so a badly-matched draft never costs
+    # throughput. Reset optimistic at each admission.
+    serve_spec_accept_floor = _Flag(0.35)
+    # EWMA smoothing factor for the per-slot acceptance rate feeding the
+    # floor above (new = (1-a)*old + a*step_rate). Larger = faster demotion
+    # of low-acceptance slots, noisier signal.
+    serve_spec_accept_alpha = _Flag(0.3)
 
     # -- rllib (Podracer-scale RL) ---------------------------------------------
     # Rollout transport for IMPALA/APPO: 1 parks the env runners in a

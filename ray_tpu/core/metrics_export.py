@@ -110,7 +110,9 @@ def serve_ttft_hist() -> um.Histogram:
     return _metric(
         um.Histogram, "ray_tpu_serve_ttft_s",
         "LLM serving time-to-first-token (request submit to first token), "
-        "phase-split: total | queued | prefill | decode",
+        "phase-split: total | queued | prefill | decode | spec "
+        "(spec = the fused propose+verify dispatch of the first chunk, "
+        "speculative engines only)",
         boundaries=_LATENCY_BOUNDS, tag_keys=("deployment", "phase"))
 
 
@@ -124,6 +126,26 @@ def serve_kv_hit_tokens_total() -> um.Counter:
     return _metric(um.Counter, "ray_tpu_serve_kv_hit_tokens_total",
                    "Prompt tokens served from the paged KV prefix cache "
                    "(prefill FLOPs avoided)",
+                   tag_keys=("deployment",))
+
+
+def serve_spec_proposed_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_serve_spec_proposed_total",
+                   "Draft tokens proposed by speculative decoding",
+                   tag_keys=("deployment",))
+
+
+def serve_spec_accepted_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_serve_spec_accepted_total",
+                   "Draft tokens accepted by the target model's "
+                   "speculative verify",
+                   tag_keys=("deployment",))
+
+
+def serve_spec_accept_ratio() -> um.Gauge:
+    return _metric(um.Gauge, "ray_tpu_serve_spec_accept_ratio",
+                   "Cumulative speculative-decoding acceptance ratio "
+                   "(accepted / proposed draft tokens)",
                    tag_keys=("deployment",))
 
 

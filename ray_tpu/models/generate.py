@@ -24,6 +24,23 @@ from jax import lax
 
 from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.ops.layers import gelu, layer_norm, linear, rope
+from ray_tpu.ops.paged_attention import paged_attention
+
+
+def resolve_attention_kernel(mode: Optional[str]) -> str:
+    """Resolve the ``serve_paged_attention_kernel`` knob to a concrete mode:
+    ``pallas`` (compiled kernel), ``interpret`` (Pallas interpret mode — the
+    CPU tier-1 path exercising the same kernel), or ``gather`` (the XLA
+    table-gather formulation). ``auto`` picks pallas on TPU and gather on
+    CPU, where interpret-mode per-token dispatch would tax the test suite."""
+    mode = (mode or "auto").lower()
+    if mode == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "gather"
+    if mode not in ("pallas", "interpret", "gather"):
+        raise ValueError(
+            f"serve_paged_attention_kernel must be auto|pallas|interpret|"
+            f"gather, got {mode!r}")
+    return mode
 
 
 def init_cache(config: TransformerConfig, batch: int, max_len: Optional[int] = None) -> Dict:
@@ -305,9 +322,24 @@ def init_block_pool(config: TransformerConfig, num_blocks: int,
     return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
 
 
+def _paged_attend(q, k_pool, v_pool, tables, lengths, *, scale, kernel):
+    """Attention over the paged pool for one layer, switched by ``kernel``:
+    the Pallas kernel streams only live blocks (compiled on TPU, interpret
+    on CPU); ``gather`` is the legacy table-gather + dense-mask path."""
+    if kernel in ("pallas", "interpret"):
+        return paged_attention(q, k_pool, v_pool, tables, lengths,
+                               scale=scale, interpret=kernel == "interpret")
+    S, T = q.shape[:2]
+    nb, bt, H, D = k_pool.shape
+    nb_seq = tables.shape[1]
+    kc = k_pool[tables].reshape(S, nb_seq * bt, H, D)
+    vc = v_pool[tables].reshape(S, nb_seq * bt, H, D)
+    return _attend_cached(q, kc, vc, lengths + T, scale=scale)
+
+
 def _forward_prefill_paged(params, tokens, k_pool, v_pool, table, start_pos,
                            suffix_len, config: TransformerConfig,
-                           block_tokens: int):
+                           block_tokens: int, kernel: str = "gather"):
     """Prefill ``tokens`` [1, P] (a SUFFIX bucket) at absolute positions
     [start_pos, start_pos+P) into the paged pool through ``table`` [NB].
 
@@ -328,7 +360,7 @@ def _forward_prefill_paged(params, tokens, k_pool, v_pool, table, start_pos,
         h = h + cast(params["pos_embed"])[jnp.minimum(
             positions, c.max_seq_len - 1)][None]
     scale = 1.0 / c.head_dim**0.5
-    valid_len = start_pos + P
+    lengths1 = jnp.reshape(start_pos, (1,)).astype(jnp.int32)
     write_ok = jnp.arange(P) < suffix_len
     blk = jnp.where(write_ok,
                     table[jnp.clip(positions // bt, 0, NB - 1)], 0)
@@ -345,9 +377,8 @@ def _forward_prefill_paged(params, tokens, k_pool, v_pool, table, start_pos,
             k = rope(k, positions[None])
         k_pool = k_pool.at[layer, blk, off].set(k[0])
         v_pool = v_pool.at[layer, blk, off].set(v[0])
-        kc = k_pool[layer][table].reshape(1, NB * bt, c.n_heads, c.head_dim)
-        vc = v_pool[layer][table].reshape(1, NB * bt, c.n_heads, c.head_dim)
-        o = _attend_cached(q, kc, vc, valid_len, scale=scale)
+        o = _paged_attend(q, k_pool[layer], v_pool[layer], table[None],
+                          lengths1, scale=scale, kernel=kernel)
         o = jnp.einsum("bthk,hkd->btd", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
         h = h + o
         x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
@@ -361,28 +392,37 @@ def _forward_prefill_paged(params, tokens, k_pool, v_pool, table, start_pos,
 
 
 def _forward_decode_paged(params, tokens, k_pool, v_pool, tables, lengths,
-                          config: TransformerConfig, block_tokens: int):
-    """One decode step for S sequences over the paged pool: ``tokens``
-    [S, 1] at per-slot positions ``lengths`` [S], each slot's K/V scattered
-    into block ``tables[s, pos // bt]`` row ``pos % bt`` and attention
-    gathered back through its table row. Inactive slots carry all-trash
-    tables, so their writes land in block 0 and their outputs are dead."""
+                          config: TransformerConfig, block_tokens: int,
+                          kernel: str = "gather"):
+    """Decode ``tokens`` [S, T] for S sequences over the paged pool: slot
+    s's token t sits at absolute position ``lengths[s] + t`` (T > 1 is the
+    speculative-decoding verify), its K/V scattered into block
+    ``tables[s, pos // bt]`` row ``pos % bt`` and attention run back through
+    the table row. Inactive slots carry all-trash tables, so their writes
+    land in block 0 and their outputs are dead.
+
+    Positions at or past table capacity redirect their writes to trash
+    block 0 rather than clamping onto the last cell — a slot at capacity
+    must be finished as ``length_cap`` by the engine BEFORE dispatch, so
+    in-range rows never see a silently overwritten chain; the redirect only
+    shields parked/speculative overhang writes."""
     c = config
     cast = lambda p: p.astype(c.dtype)
-    S, T = tokens.shape  # T == 1
+    S, T = tokens.shape
     NB = tables.shape[1]
     bt = block_tokens
     max_len = NB * bt
     h = jnp.take(cast(params["tok_embed"]), tokens, axis=0)
-    pos = jnp.minimum(lengths, max_len - 1)
-    positions = pos[:, None]
+    positions = lengths[:, None] + jnp.arange(T)[None, :]  # [S, T]
+    write_ok = positions < max_len
+    pos_c = jnp.minimum(positions, max_len - 1)
     if c.pos == "learned":
-        h = h + cast(params["pos_embed"])[positions]
+        h = h + cast(params["pos_embed"])[jnp.minimum(
+            positions, c.max_seq_len - 1)]
     scale = 1.0 / c.head_dim**0.5
-    rows = jnp.arange(S)
-    blk = tables[rows, pos // bt]
-    off = pos % bt
-    valid_len = pos + 1
+    rows = jnp.arange(S)[:, None]
+    blk = jnp.where(write_ok, tables[rows, pos_c // bt], 0)
+    off = pos_c % bt
 
     for layer in range(c.n_layers):
         bp = jax.tree.map(lambda p: cast(p[layer]), params["blocks"])
@@ -393,11 +433,10 @@ def _forward_decode_paged(params, tokens, k_pool, v_pool, tables, lengths,
         if c.pos == "rope":
             q = rope(q, positions)
             k = rope(k, positions)
-        k_pool = k_pool.at[layer, blk, off].set(k[:, 0])
-        v_pool = v_pool.at[layer, blk, off].set(v[:, 0])
-        kc = k_pool[layer][tables].reshape(S, max_len, c.n_heads, c.head_dim)
-        vc = v_pool[layer][tables].reshape(S, max_len, c.n_heads, c.head_dim)
-        o = _attend_cached(q, kc, vc, valid_len, scale=scale)
+        k_pool = k_pool.at[layer, blk, off].set(k)
+        v_pool = v_pool.at[layer, blk, off].set(v)
+        o = _paged_attend(q, k_pool[layer], v_pool[layer], tables, lengths,
+                          scale=scale, kernel=kernel)
         o = jnp.einsum("bthk,hkd->btd", o, bp["wo"], preferred_element_type=jnp.float32).astype(c.dtype) + bp["bo"]
         h = h + o
         x = layer_norm(h, bp["ln2_g"], bp["ln2_b"])
@@ -424,7 +463,10 @@ class PagedGenerator:
 
     def __init__(self, params, config: TransformerConfig, *, slots: int,
                  num_blocks: int, block_tokens: int,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 attention_kernel: str = "auto",
+                 draft_params=None,
+                 draft_config: Optional[TransformerConfig] = None):
         self.params = params
         self.config = config
         self.slots = slots
@@ -436,6 +478,16 @@ class PagedGenerator:
                 f"serve_kv_block_tokens {self.block_tokens}")
         self.blocks_per_seq = self.max_len // self.block_tokens
         self.num_blocks = int(num_blocks)
+        self.attention_kernel = resolve_attention_kernel(attention_kernel)
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config go together")
+        if draft_config is not None and (
+                draft_config.vocab_size != config.vocab_size):
+            raise ValueError(
+                f"draft vocab {draft_config.vocab_size} != target vocab "
+                f"{config.vocab_size} — speculative verify needs one vocab")
+        self.draft_params = draft_params
+        self.draft_config = draft_config
         self.logits_dim = (params["tok_embed"].shape[0]
                           if config.tie_embeddings
                           else params["lm_head"].shape[-1])
@@ -444,6 +496,8 @@ class PagedGenerator:
         self._extract_fns = {}   # nb -> jitted block gather (KV handoff out)
         self._insert_fns = {}    # nb -> jitted block scatter (KV handoff in)
         self._copy_fn = None
+        self._draft_prefill_fns = {}  # suffix bucket -> jitted draft prefill
+        self._spec_decode_fns = {}    # (chunk, k) -> jitted spec decode
 
     def init_state(self):
         k_pool, v_pool = init_block_pool(self.config, self.num_blocks,
@@ -451,6 +505,13 @@ class PagedGenerator:
         last = jnp.zeros((self.slots, self.logits_dim), jnp.float32)
         keys = jnp.zeros((self.slots, 2), jnp.uint32)
         return k_pool, v_pool, last, keys
+
+    def init_draft_state(self):
+        """Draft-model pool mirroring the target pool's block geometry: the
+        SAME block tables index both, so advance/rollback bookkeeping is
+        shared and speculation adds zero KVBlockManager state."""
+        return init_block_pool(self.draft_config, self.num_blocks,
+                               self.block_tokens)
 
     def prefill_fn(self, bucket: int):
         """paged_prefill(params, k_pool, v_pool, last, keys, table [NB],
@@ -462,13 +523,14 @@ class PagedGenerator:
             return fn
         c = self.config
         bt = self.block_tokens
+        kernel = self.attention_kernel
 
         @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
         def paged_prefill(params, k_pool, v_pool, last, keys, table, padded,
                           start_pos, suffix_len, slot, seed):
             logits, k_pool, v_pool = _forward_prefill_paged(
                 params, padded, k_pool, v_pool, table, start_pos,
-                suffix_len, c, bt)
+                suffix_len, c, bt, kernel=kernel)
             row = jax.lax.dynamic_index_in_dim(
                 logits, suffix_len - 1, axis=1, keepdims=False)     # [1, V]
             last = lax.dynamic_update_slice(last, row, (slot, 0))
@@ -489,6 +551,7 @@ class PagedGenerator:
             return fn
         c = self.config
         bt = self.block_tokens
+        kernel = self.attention_kernel
 
         @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
         def paged_decode(params, k_pool, v_pool, last, keys, tables, lengths,
@@ -506,7 +569,8 @@ class PagedGenerator:
                 nxt = jnp.where(greedy, jnp.argmax(real, axis=-1),
                                 samp).astype(jnp.int32)
                 logits, k_p, v_p = _forward_decode_paged(
-                    params, nxt[:, None], k_p, v_p, tables, lens, c, bt)
+                    params, nxt[:, None], k_p, v_p, tables, lens, c, bt,
+                    kernel=kernel)
                 lens = lens + adv
                 last = jnp.where(act_col, logits[:, -1], last)
                 keys = jnp.where(act_col, keys2, keys)
@@ -519,6 +583,217 @@ class PagedGenerator:
 
         self._decode_fns[chunk] = paged_decode
         return paged_decode
+
+    def draft_prefill_fn(self, bucket: int):
+        """draft_prefill(draft_params, kd_pool, vd_pool, table [NB],
+        padded [1,P], start_pos, suffix_len) -> (kd_pool, vd_pool): run the
+        DRAFT model over the same suffix bucket through the same block
+        table so its pool holds draft-KV for every position the target
+        holds — the draft chain in :meth:`spec_decode_fn` then starts from
+        a warm cache. Logits are discarded (the first proposal conditions
+        on the verified tail, not on prefill output)."""
+        fn = self._draft_prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        dc = self.draft_config
+        bt = self.block_tokens
+        kernel = self.attention_kernel
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def draft_prefill(draft_params, kd_pool, vd_pool, table, padded,
+                          start_pos, suffix_len):
+            _, kd_pool, vd_pool = _forward_prefill_paged(
+                draft_params, padded, kd_pool, vd_pool, table, start_pos,
+                suffix_len, dc, bt, kernel=kernel)
+            return kd_pool, vd_pool
+
+        self._draft_prefill_fns[bucket] = draft_prefill
+        return draft_prefill
+
+    def spec_decode_fn(self, chunk: int, k: int):
+        """Speculative decode: ``chunk`` scan steps, each proposing ``k``
+        draft tokens and verifying them in ONE batched target forward.
+
+        spec_decode(params, draft_params, k_pool, v_pool, kd_pool, vd_pool,
+        last, keys, tables, lengths, active, greedy, temps, spec_on, tail,
+        pending, use_pending) -> (toks [S, chunk, k+1], counts [S, chunk],
+        accepted [S, chunk], k_pool, v_pool, kd_pool, vd_pool, last, keys,
+        tail, pending, use_pending).
+
+        Per step and slot: token n0 comes from ``last`` (or the carried
+        rejection replacement when ``use_pending``); the draft runs k+1
+        single-token forwards — forward 0 re-consumes ``tail`` (the last
+        accepted token) at position len-1, an idempotent KV rewrite that
+        also fills the one draft-KV hole a fully-accepted previous step
+        leaves, then forwards 1..k consume n0, d_1, ..., d_{k-1} and emit
+        proposals d_1..d_k with their logits. The target verifies
+        [n0, d_1..d_k] in one [S, k+1] forward. Acceptance is rejection
+        sampling — u < p(d)/q(d) preserves the target distribution for ANY
+        draft; the greedy path is exact argmax prefix match — and the slot
+        advances 1 + a tokens where a is the accepted prefix length. On
+        rejection at a < k, a replacement is drawn from the normalized
+        residual max(p - q, 0) (greedy: target argmax) and carried as
+        ``pending`` to be next step's n0; on full acceptance ``last``
+        becomes the verify logits at position k. Only valid positions
+        (< lengths + 1 + a) survive in the pools — overhang writes are
+        overwritten by the next step before they become attendable, and
+        retirement publishes only real tokens.
+
+        ``toks[s, t, :counts[s, t]]`` are the emitted tokens of step t.
+        ``spec_on`` False (acceptance EWMA below floor, or no table
+        headroom for chunk*(k+1)) degrades the slot to the plain one-token
+        path inside the same program: proposals are force-rejected so
+        a == 0 and exactly n0 is emitted per step."""
+        key_ck = (chunk, k)
+        fn = self._spec_decode_fns.get(key_ck)
+        if fn is not None:
+            return fn
+        if self.draft_config is None:
+            raise ValueError("spec_decode_fn requires a draft model")
+        if k < 1:
+            raise ValueError("serve_spec_tokens must be >= 1 when "
+                             "speculative decoding is enabled")
+        c = self.config
+        dc = self.draft_config
+        bt = self.block_tokens
+        kernel = self.attention_kernel
+        V = c.vocab_size
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7))
+        def spec_decode(params, draft_params, k_pool, v_pool, kd_pool,
+                        vd_pool, last, keys, tables, lengths, active, greedy,
+                        temps, spec_on, tail, pending, use_pending):
+            adv_gate = active.astype(jnp.int32)
+            act_col = active[:, None]
+            temp_safe = jnp.maximum(temps, 1e-6)[:, None]
+
+            def step(carry, _):
+                (k_p, v_p, kd_p, vd_p, lens, last, keys, tail, pending,
+                 use_pending) = carry
+                nsub = 2 * k + 3
+                split = jax.vmap(
+                    lambda kk: jax.random.split(kk, nsub))(keys)
+                keys2 = split[:, 0]
+                sub_n0 = split[:, 1]
+                sub_draft = split[:, 2:2 + k]            # [S, k, 2]
+                sub_acc = split[:, 2 + k:2 + 2 * k]      # [S, k, 2]
+                sub_res = split[:, 2 + 2 * k]            # [S, 2]
+
+                real = last[:, :V]
+                samp = jax.vmap(jax.random.categorical)(
+                    sub_n0, real / temp_safe)
+                n0 = jnp.where(
+                    use_pending, pending,
+                    jnp.where(greedy, jnp.argmax(real, axis=-1),
+                              samp)).astype(jnp.int32)
+
+                # Draft chain: k+1 single-token forwards through the SHARED
+                # block tables into the draft pool.
+                cur_tok = tail
+                cur_pos = jnp.maximum(lens - 1, 0)
+                proposals, dlogits = [], []
+                for i in range(k + 1):
+                    dl, kd_p, vd_p = _forward_decode_paged(
+                        draft_params, cur_tok[:, None], kd_p, vd_p, tables,
+                        cur_pos, dc, bt, kernel=kernel)
+                    if i == 0:
+                        # Forward 0 only (re)writes tail's draft KV at
+                        # lens-1; its logits are superseded by n0's chain.
+                        cur_tok, cur_pos = n0, lens
+                        continue
+                    dreal = dl[:, 0, :V]
+                    d_samp = jax.vmap(jax.random.categorical)(
+                        sub_draft[:, i - 1], dreal / temp_safe)
+                    d_i = jnp.where(greedy, jnp.argmax(dreal, axis=-1),
+                                    d_samp).astype(jnp.int32)
+                    proposals.append(d_i)
+                    dlogits.append(dreal)
+                    cur_tok, cur_pos = d_i, lens + i
+
+                # Single batched target verify over [n0, d_1..d_k].
+                verify = jnp.stack([n0] + proposals, axis=1)   # [S, k+1]
+                logits, k_p, v_p = _forward_decode_paged(
+                    params, verify, k_p, v_p, tables, lens, c, bt,
+                    kernel=kernel)
+                treal = logits[:, :, :V]                       # [S, k+1, V]
+
+                props = jnp.stack(proposals, axis=1)           # [S, k]
+                dreal_all = jnp.stack(dlogits, axis=1)         # [S, k, V]
+                # Greedy acceptance: exact argmax prefix match. Sampled:
+                # u < p(d)/q(d) (target/draft probability of the proposal).
+                match = props == jnp.argmax(treal[:, :k], axis=-1)
+                tcol = temp_safe[:, :, None]
+                p_probs = jax.nn.softmax(treal[:, :k] / tcol, axis=-1)
+                q_probs = jax.nn.softmax(dreal_all / tcol, axis=-1)
+                p_d = jnp.take_along_axis(
+                    p_probs, props[..., None], axis=-1)[..., 0]
+                q_d = jnp.take_along_axis(
+                    q_probs, props[..., None], axis=-1)[..., 0]
+                u = jax.vmap(jax.vmap(
+                    lambda kk: jax.random.uniform(kk)))(sub_acc)
+                samp_ok = u * jnp.maximum(q_d, 1e-30) < p_d
+                ok = jnp.where(greedy[:, None], match, samp_ok)
+                ok = ok & spec_on[:, None] & active[:, None]
+                run = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+                a = jnp.sum(run, axis=1)                       # [S] in 0..k
+                full = a == k
+                adv = (1 + a) * adv_gate
+                lens_new = lens + adv
+
+                # Replacement at the rejection point: residual sampling
+                # max(p - q, 0) keeps the OVERALL emitted distribution equal
+                # to the target's (greedy: plain target argmax).
+                t_at_a = jnp.take_along_axis(
+                    treal, a[:, None, None], axis=1)[:, 0]     # [S, V]
+                q_at_a = jnp.take_along_axis(
+                    dreal_all, jnp.minimum(a, k - 1)[:, None, None],
+                    axis=1)[:, 0]
+                p_a = jax.nn.softmax(t_at_a / temp_safe, axis=-1)
+                q_a = jax.nn.softmax(q_at_a / temp_safe, axis=-1)
+                resid = jnp.maximum(p_a - q_a, 0.0)
+                rsum = jnp.sum(resid, axis=-1, keepdims=True)
+                resid = jnp.where(rsum > 0, resid / rsum, p_a)
+                r_samp = jax.vmap(jax.random.categorical)(
+                    sub_res, jnp.log(resid + 1e-30))
+                repl = jnp.where(greedy, jnp.argmax(t_at_a, axis=-1),
+                                 r_samp).astype(jnp.int32)
+
+                tail_new = jnp.take_along_axis(
+                    verify, a[:, None], axis=1)[:, 0]
+                tail = jnp.where(active, tail_new, tail)
+                pending = jnp.where(active, repl, pending)
+                # A spec_on slot that rejected carries the residual draw as
+                # next step's n0 (use_pending); a fully-accepted slot
+                # refreshes `last` from verify position k. A spec_OFF slot
+                # never really rejected (the gate force-fails acceptance),
+                # so the residual draw would be the WRONG distribution —
+                # it refreshes `last` from verify position 0 (its n0's
+                # logits, exactly the plain decode chain) and drops any
+                # pending carry.
+                use_pending = jnp.where(active, ~full & spec_on,
+                                        use_pending)
+                refresh = active & (full | ~spec_on)
+                row_idx = jnp.where(spec_on, k, 0)
+                row = jnp.take_along_axis(
+                    logits, row_idx[:, None, None], axis=1)[:, 0]
+                last = jnp.where(refresh[:, None], row, last)
+                keys = jnp.where(act_col, keys2, keys)
+                return ((k_p, v_p, kd_p, vd_p, lens_new, last, keys, tail,
+                         pending, use_pending),
+                        (verify, adv, a * adv_gate))
+
+            carry0 = (k_pool, v_pool, kd_pool, vd_pool,
+                      jnp.asarray(lengths), last, keys, jnp.asarray(tail),
+                      jnp.asarray(pending), jnp.asarray(use_pending))
+            (k_pool, v_pool, kd_pool, vd_pool, _lens, last, keys, tail,
+             pending, use_pending), (toks, counts, accepted) = lax.scan(
+                step, carry0, None, length=chunk)
+            return (toks.transpose(1, 0, 2), counts.T, accepted.T,
+                    k_pool, v_pool, kd_pool, vd_pool, last, keys, tail,
+                    pending, use_pending)
+
+        self._spec_decode_fns[key_ck] = spec_decode
+        return spec_decode
 
     def copy_fn(self):
         """copy_block(k_pool, v_pool, src, dst) -> (k_pool, v_pool): the

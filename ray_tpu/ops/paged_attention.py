@@ -1,0 +1,185 @@
+"""Paged attention — a Pallas TPU decode kernel over the block-pool KV cache.
+
+The serve engine's decode hot path (``models/generate.py
+_forward_decode_paged``) holds K/V in a SHARED pool of
+``block_tokens``-sized blocks addressed through per-sequence block tables.
+The straightforward JAX formulation gathers the whole table back out —
+``k_pool[tables].reshape(S, max_len, H, D)`` — which materializes
+S × max_len × H × D every token and reads every pool block a slot's table
+points at, live or not. Decode is memory-bandwidth-bound, so that gather is
+exactly the HBM traffic the roofline says we cannot afford.
+
+This kernel reads the block table NATIVELY instead: the table and the
+per-slot lengths ride in as scalar-prefetch operands
+(``pltpu.PrefetchScalarGridSpec``), so the BlockSpec index map dereferences
+``tables[s, j]`` on the host side of the DMA pipeline and each grid program
+streams pool blocks straight from HBM into VMEM — only the
+``ceil(len/block_tokens)`` LIVE blocks of its slot do real work. Dead table
+entries point at the reserved trash block 0, and because consecutive grid
+steps that map to the same pool block skip the re-fetch, the dead tail of a
+table costs one block of traffic, not ``NB - live``. Softmax is the online
+(m, l, acc) accumulator pattern shared with ``flash_attention._flash_kernel``,
+held in VMEM scratch across the kv sweep.
+
+Layout: ``q`` [S, T, H, D] — T > 1 is the multi-token speculative-decoding
+verify (and the paged prefill, S == 1): query t of slot s sits at absolute
+position ``lengths[s] + t`` and attends kv positions ``<= lengths[s] + t``.
+The T new tokens' K/V must already be scattered into the pool at those
+positions (the caller writes K/V first, then attends — same order as the
+gather path).
+
+Runs compiled on TPU and in interpret mode on CPU (the tier-1 path);
+``paged_attention_reference`` is the gather-path oracle the kernel is
+validated against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(
+    tables_ref, lengths_ref,   # scalar prefetch: [S, NB] int32, [S] int32
+    q_ref,                     # [1, H, T, D] block
+    k_ref, v_ref,              # [1, bt, H, D] block — pool block tables[s, j]
+    o_ref,                     # [1, H, T, D] block
+    m_scr, l_scr, acc_scr,     # VMEM scratch: [H*T, 1], [H*T, 1], [H*T, D]
+    *,
+    scale: float,
+    block_tokens: int,
+    num_heads: int,
+    q_tokens: int,
+    nb_seq: int,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    bt, H, T = block_tokens, num_heads, q_tokens
+    ctx = lengths_ref[s]
+    # Highest block index holding any attendable position: query T-1 sits at
+    # ctx + T - 1. Blocks past it are dead — their table entries are trash
+    # (block 0), the revisit-skip makes their DMA free, and the body skips.
+    last_blk = jax.lax.div(ctx + T - 1, bt)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j <= last_blk)
+    def _body():
+        qb = q_ref[0].astype(jnp.float32)            # [H, T, D]
+        kb = k_ref[0].astype(jnp.float32)            # [bt, H, D]
+        vb = v_ref[0].astype(jnp.float32)            # [bt, H, D]
+        # Causal + validity in one mask: kv position vs absolute q position.
+        kv_pos = j * bt + jax.lax.broadcasted_iota(jnp.int32, (T, bt), 1)
+        q_pos = ctx + jax.lax.broadcasted_iota(jnp.int32, (T, bt), 0)
+        mask = kv_pos <= q_pos
+        for h in range(H):                           # static unroll
+            scores = jax.lax.dot_general(
+                qb[h], kb[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                 # [T, bt]
+            scores = jnp.where(mask, scores, _NEG_INF)
+            r0, r1 = h * T, (h + 1) * T
+            m_prev = m_scr[r0:r1]                     # [T, 1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)               # [T, bt]
+            l_scr[r0:r1] = alpha * l_scr[r0:r1] + jnp.sum(
+                p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, vb[:, h, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                         # [T, D]
+            acc_scr[r0:r1] = acc_scr[r0:r1] * alpha + pv
+            m_scr[r0:r1] = m_new
+
+    @pl.when(j == nb_seq - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:], 1e-30)          # [H*T, 1]
+        out = (acc_scr[:] / denom).reshape(H, T, acc_scr.shape[-1])
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,                # [S, T, H, D]
+    k_pool: jax.Array,           # [num_blocks, bt, H, D] (one layer's pool)
+    v_pool: jax.Array,
+    tables: jax.Array,           # [S, NB] int32 — pool block ids, 0 = trash
+    lengths: jax.Array,          # [S] int32 — valid context BEFORE the T tokens
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused paged-attention over the block pool; returns [S, T, H, D].
+
+    Query t of slot s is at absolute position ``lengths[s] + t`` and attends
+    positions ``<= lengths[s] + t`` gathered through ``tables[s]``. No
+    ``[S, max_len, H, D]`` intermediate exists at any point."""
+    S, T, H, D = q.shape
+    bt = k_pool.shape[1]
+    nb_seq = tables.shape[1]
+    s_val = scale if scale is not None else 1.0 / D**0.5
+    tables = tables.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    qt = q.transpose(0, 2, 1, 3)                      # [S, H, T, D]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, nb_seq),
+        in_specs=[
+            pl.BlockSpec((1, H, T, D), lambda s, j, tbl, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bt, H, D),
+                         lambda s, j, tbl, ln: (tbl[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, bt, H, D),
+                         lambda s, j, tbl, ln: (tbl[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, T, D),
+                               lambda s, j, tbl, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H * T, 1), jnp.float32),
+            pltpu.VMEM((H * T, 1), jnp.float32),
+            pltpu.VMEM((H * T, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=s_val, block_tokens=bt, num_heads=H,
+            q_tokens=T, nb_seq=nb_seq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, T, D), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, qt, k_pool, v_pool)
+    return out.transpose(0, 2, 1, 3)                  # [S, T, H, D]
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, lengths, *,
+                              scale: Optional[float] = None) -> jax.Array:
+    """Gather-path oracle: materializes [S, NB*bt, H, D] through the table
+    and runs masked dense attention — numerically what the pre-kernel decode
+    did, kept as the equivalence target and the CPU fallback reference."""
+    S, T, H, D = q.shape
+    bt = k_pool.shape[1]
+    nb_seq = tables.shape[1]
+    s_val = scale if scale is not None else 1.0 / D**0.5
+    kc = k_pool[tables].reshape(S, nb_seq * bt, H, D)
+    vc = v_pool[tables].reshape(S, nb_seq * bt, H, D)
+    scores = jnp.einsum("bthd,bshd->bhts", q, kc,
+                        preferred_element_type=jnp.float32) * s_val
+    kv_pos = jnp.arange(nb_seq * bt)[None, None, None, :]
+    q_pos = (lengths.reshape(-1, 1, 1, 1)
+             + jnp.arange(T)[None, None, :, None])
+    scores = jnp.where(kv_pos <= q_pos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vc.astype(jnp.float32))
+    return out.astype(q.dtype)

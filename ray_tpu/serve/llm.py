@@ -239,6 +239,18 @@ class LLMEngine:
             active, greedy, temps)
         return toks
 
+    def _slot_result(self, host_toks, slot: int):
+        """The step's emitted tokens for ``slot`` plus its device-length
+        advance. The base engine always emits exactly ``chunk`` tokens; the
+        speculative paged engine emits a variable 1..chunk*(k+1) depending
+        on per-step acceptance. Called under _state_lock."""
+        return [int(t) for t in host_toks[slot][:self.chunk]], self.chunk
+
+    def _chunk_span_attrs(self, slot: int) -> Optional[Dict]:
+        """Extra attrs merged into a sampled request's ``llm.decode_chunk``
+        span (the spec engine reports proposed/accepted counts)."""
+        return None
+
     def _release_slot_device(self, slot: int) -> None:
         """Per-slot device-side cleanup when a slot frees (paged: unpin the
         slot's blocks). Called under _state_lock; must be idempotent."""
@@ -552,17 +564,18 @@ class LLMEngine:
                 req = self._slot_req[slot]
                 if req is None or not active[slot]:
                     continue
-                self._slot_len[slot] += self.chunk
+                emitted, adv = self._slot_result(host_toks, slot)
+                self._slot_len[slot] += adv
                 if req.cancelled:
                     self._free_slot_locked(slot)
                     continue
-                upto = min(self.chunk, req.max_new - req.emitted)
+                upto = min(len(emitted), req.max_new - req.emitted)
                 if upto > 0 and req.ttft_s is None:
                     req.ttft_s = now - req.submitted_at
                     ttfts.append((req.ttft_s, req.queued_s, req.prefill_s))
                 if req.trace_ctx is not None and upto > 0:
                     chunk_spans.append((req.trace_ctx, slot, upto))
-                new_toks = [int(t) for t in host_toks[slot][:upto]]
+                new_toks = emitted[:upto]
                 req.tokens.extend(new_toks)
                 req.out_ids.extend(new_toks)
                 req.emitted += upto
@@ -578,9 +591,17 @@ class LLMEngine:
             self.decode_seconds += dt
         # Emitted OUTSIDE _state_lock: span export may take its own locks.
         for ctx, slot, ntok in chunk_spans:
+            attrs = {"slot": slot, "tokens": ntok, "batch": batch_size}
+            extra_attrs = self._chunk_span_attrs(slot)
+            if extra_attrs:
+                attrs.update(extra_attrs)
+                # Propose + verify run fused in the one spec dispatch, so
+                # the spec span's duration IS the step's device time; the
+                # attrs carry the per-slot proposed/accepted split.
+                tracing.emit("llm.spec", ctx, duration=dt, end_time=None,
+                             attrs={"slot": slot, **extra_attrs})
             tracing.emit("llm.decode_chunk", ctx, duration=dt, end_time=None,
-                         attrs={"slot": slot, "tokens": ntok,
-                                "batch": batch_size})
+                         attrs=attrs)
         self._observe(delivered_total, ttfts)
 
     def _observe(self, delivered: int, ttfts: List[tuple]) -> None:
@@ -700,7 +721,11 @@ class PagedLLMEngine(LLMEngine):
 
     def __init__(self, params, config: TransformerConfig, *,
                  block_tokens: Optional[int] = None,
-                 pool_blocks: Optional[int] = None, **kw):
+                 pool_blocks: Optional[int] = None,
+                 attention_kernel: Optional[str] = None,
+                 draft_params=None,
+                 draft_config: Optional[TransformerConfig] = None,
+                 spec_tokens: Optional[int] = None, **kw):
         from ray_tpu.core.config import config as _get_config
 
         knobs = _get_config()
@@ -708,6 +733,20 @@ class PagedLLMEngine(LLMEngine):
                                 else knobs.serve_kv_block_tokens)
         self._pool_blocks_cfg = int(pool_blocks if pool_blocks is not None
                                     else knobs.serve_kv_pool_blocks)
+        self.attention_kernel = str(
+            attention_kernel if attention_kernel is not None
+            else knobs.serve_paged_attention_kernel)
+        self.spec_k = int(spec_tokens if spec_tokens is not None
+                          else knobs.serve_spec_tokens)
+        if self.spec_k > 0 and draft_params is None:
+            raise ValueError(
+                "serve_spec_tokens > 0 needs a draft model "
+                "(draft_params/draft_config)")
+        self._draft_params = draft_params
+        self._draft_config = draft_config
+        self._spec = self.spec_k > 0
+        self._spec_floor = float(knobs.serve_spec_accept_floor)
+        self._spec_alpha = float(knobs.serve_spec_accept_alpha)
         super().__init__(params, config, **kw)
 
     # -- device-half hooks ----------------------------------------------------
@@ -720,7 +759,10 @@ class PagedLLMEngine(LLMEngine):
         self._pg = PagedGenerator(self.params, self.config, slots=self.slots,
                                   num_blocks=num_blocks,
                                   block_tokens=self.block_tokens,
-                                  max_len=self.max_len)
+                                  max_len=self.max_len,
+                                  attention_kernel=self.attention_kernel,
+                                  draft_params=self._draft_params,
+                                  draft_config=self._draft_config)
         self.kv = KVBlockManager(num_blocks, self.block_tokens)
         (self._k_pool, self._v_pool,
          self._last, self._keys) = self._pg.init_state()
@@ -728,6 +770,28 @@ class PagedLLMEngine(LLMEngine):
                                     np.int32)
         self._slot_blocks: List[List[int]] = [[] for _ in range(self.slots)]
         self._hit_pending = 0  # hit tokens awaiting metric flush (step thread)
+        self._init_spec_state()
+
+    def _init_spec_state(self) -> None:
+        # Speculative-decoding host state — all [S], step-thread-owned
+        # except the per-slot resets at admission/release (under
+        # _state_lock, which the step thread also holds there).
+        if not self._spec:
+            return
+        self._kd_pool, self._vd_pool = self._pg.init_draft_state()
+        self._spec_tail = np.zeros(self.slots, np.int32)
+        self._spec_pending = np.zeros(self.slots, np.int32)
+        self._spec_use_pending = np.zeros(self.slots, bool)
+        self._spec_ewma = np.ones(self.slots, np.float32)
+        self._spec_on = np.zeros(self.slots, bool)
+        self._last_counts = None        # last spec step's [S, chunk] advances
+        self._spec_last_accept = np.zeros(self.slots, np.int64)
+        self._spec_last_on = np.zeros(self.slots, bool)
+        self._spec_last_dt = 0.0
+        self._spec_proposed_pending = 0  # await metric flush (step thread)
+        self._spec_accepted_pending = 0
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
 
     def _reset_device_state(self) -> None:
         (self._k_pool, self._v_pool,
@@ -736,6 +800,7 @@ class PagedLLMEngine(LLMEngine):
         self.kv = KVBlockManager(self.kv.num_blocks, self.block_tokens)
         self._slot_table[:] = 0
         self._slot_blocks = [[] for _ in range(self.slots)]
+        self._init_spec_state()
 
     def warmup(self) -> None:
         with self._step_lock:
@@ -756,6 +821,29 @@ class PagedLLMEngine(LLMEngine):
             np.asarray(toks)
             cf = self._pg.copy_fn()
             self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool, 0, 0)
+            if self._spec:
+                for b in self.buckets:
+                    dpf = self._pg.draft_prefill_fn(b)
+                    self._kd_pool, self._vd_pool = dpf(
+                        self._draft_params, self._kd_pool, self._vd_pool,
+                        zero_row, np.zeros((1, b), np.int32), 0, b)
+                self._kd_pool, self._vd_pool = cf(self._kd_pool,
+                                                  self._vd_pool, 0, 0)
+                sf = self._pg.spec_decode_fn(self.chunk, self.spec_k)
+                out = sf(self.params, self._draft_params, self._k_pool,
+                         self._v_pool, self._kd_pool, self._vd_pool,
+                         self._last, self._keys,
+                         np.zeros((self.slots, self.blocks_per_seq),
+                                  np.int32),
+                         np.zeros(self.slots, np.int32),
+                         np.zeros(self.slots, bool), self._greedy,
+                         self._temps, np.zeros(self.slots, bool),
+                         np.zeros(self.slots, np.int32),
+                         np.zeros(self.slots, np.int32),
+                         np.zeros(self.slots, bool))
+                np.asarray(out[0])
+                (self._k_pool, self._v_pool, self._kd_pool, self._vd_pool,
+                 self._last, self._keys) = out[3:9]
             self._reset_device_state()
 
     def _suffix_bucket(self, n: int) -> int:
@@ -802,6 +890,11 @@ class PagedLLMEngine(LLMEngine):
             cf = self._pg.copy_fn()
             self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool,
                                             int(tail), int(dst))
+            if self._spec:
+                # The draft pool mirrors the block tables, so a COW fork
+                # must duplicate the draft-side content of the tail too.
+                self._kd_pool, self._vd_pool = cf(
+                    self._kd_pool, self._vd_pool, int(tail), int(dst))
             self.kv.note_cow()
             self.kv.release([tail])  # pin the private copy, not the original
             ids.append(dst)
@@ -818,6 +911,13 @@ class PagedLLMEngine(LLMEngine):
         (self._k_pool, self._v_pool, self._last, self._keys) = pf(
             self.params, self._k_pool, self._v_pool, self._last, self._keys,
             row, padded, hit_len, suffix_len, slot, req.seed)
+        if self._spec:
+            # Warm the draft pool over the same suffix/table so the draft
+            # chain starts from draft-KV covering every committed position.
+            dpf = self._pg.draft_prefill_fn(req.bucket)
+            self._kd_pool, self._vd_pool = dpf(
+                self._draft_params, self._kd_pool, self._vd_pool, row,
+                padded, hit_len, suffix_len)
         # Commit ATOMICALLY with the cancel path: this runs outside
         # _state_lock, so a concurrent _cancel may have freed the slot
         # mid-dispatch. Attaching first and registering later would let
@@ -836,6 +936,17 @@ class PagedLLMEngine(LLMEngine):
             if n_full_prompt:
                 self.kv.register_chain(tokens, ids, n_full_prompt)
             self._hit_pending += hit_len
+            if self._spec:
+                # Fresh speculation state: the draft chain's first forward
+                # re-consumes the last prompt token at real_len - 1, so the
+                # tail starts as exactly that token. EWMA starts optimistic;
+                # the per-step headroom gate and acceptance feedback take it
+                # from there.
+                self._spec_tail[slot] = tokens[-1]
+                self._spec_pending[slot] = 0
+                self._spec_use_pending[slot] = False
+                self._spec_ewma[slot] = 1.0
+                self._spec_on[slot] = True
 
     def _attach_preloaded(self, req: _Request, slot: int) -> None:
         """Disaggregation handoff: the prompt's K/V blocks were already
@@ -860,19 +971,114 @@ class PagedLLMEngine(LLMEngine):
             self._slot_table[slot, :] = row
             self._slot_blocks[slot] = ids
             self._hit_pending += req.hit_tokens
+            if self._spec:
+                # Handed-off blocks carry no draft-side KV — the draft
+                # never saw this prompt. Speculation stays off for the
+                # request; the slot decodes one token per scan step.
+                self._spec_on[slot] = False
+                self._spec_ewma[slot] = 0.0
+                self._spec_use_pending[slot] = False
 
     def _decode_operands_locked(self):
-        return (self._slot_table.copy(),
+        base = (self._slot_table.copy(),
                 np.asarray(self._slot_len, np.int32))
+        if not self._spec:
+            return base
+        tables, lengths = base
+        # Headroom gate: a spec step can write chunk*(k+1) positions ahead,
+        # so slots without that much table room degrade to one token per
+        # step INSIDE the same program — the base retire rule
+        # (slot_len + chunk > max_len → length_cap before dispatch) stays
+        # valid either way.
+        cap = self.blocks_per_seq * self.block_tokens
+        headroom = lengths + self.chunk * (self.spec_k + 1) <= cap
+        spec_on = self._spec_on & headroom & self._active
+        return base + (spec_on, self._spec_tail.copy(),
+                       self._spec_pending.copy(),
+                       self._spec_use_pending.copy())
 
     def _run_decode(self, active, greedy, temps, extra):
-        tables, lengths = extra
-        df = self._pg.decode_fn(self.chunk)
-        (toks, self._k_pool, self._v_pool,
-         self._last, self._keys) = df(
-            self.params, self._k_pool, self._v_pool, self._last, self._keys,
-            tables, lengths, active, greedy, temps)
+        if not self._spec:
+            tables, lengths = extra
+            df = self._pg.decode_fn(self.chunk)
+            (toks, self._k_pool, self._v_pool,
+             self._last, self._keys) = df(
+                self.params, self._k_pool, self._v_pool, self._last,
+                self._keys, tables, lengths, active, greedy, temps)
+            return toks
+        tables, lengths, spec_on, tail, pending, use_pending = extra
+        if not spec_on.any() and not (use_pending & active).any():
+            # Every slot degraded (low acceptance / no headroom / handoff)
+            # and none still carries a rejection replacement: the plain
+            # one-token program is strictly cheaper than a spec step that
+            # would force-reject everything. (A just-demoted slot runs one
+            # more spec step, which consumes its pending token and clears
+            # the carry.)
+            df = self._pg.decode_fn(self.chunk)
+            (toks, self._k_pool, self._v_pool,
+             self._last, self._keys) = df(
+                self.params, self._k_pool, self._v_pool, self._last,
+                self._keys, tables, lengths, active, greedy, temps)
+            self._last_counts = None
+            self._spec_last_accept[:] = 0
+            self._spec_last_on[:] = False
+            return toks
+        sf = self._pg.spec_decode_fn(self.chunk, self.spec_k)
+        t0 = time.perf_counter()
+        (toks, counts, accepted, self._k_pool, self._v_pool, self._kd_pool,
+         self._vd_pool, self._last, self._keys, tail_j, pending_j,
+         up_j) = sf(
+            self.params, self._draft_params, self._k_pool, self._v_pool,
+            self._kd_pool, self._vd_pool, self._last, self._keys, tables,
+            lengths, active, greedy, temps, spec_on, tail, pending,
+            use_pending)
+        counts_np = np.asarray(counts)        # syncs the step
+        self._spec_last_dt = time.perf_counter() - t0
+        accepted_np = np.asarray(accepted)
+        self._last_counts = counts_np
+        # Carry the spec chain state back to host. Safe wholesale: only the
+        # step thread writes these between operand snapshot and here, and
+        # per-slot admission resets happen before the NEXT step's snapshot.
+        self._spec_tail = np.array(tail_j)
+        self._spec_pending = np.array(pending_j)
+        self._spec_use_pending = np.array(up_j)
+        # Acceptance EWMA feeds next step's gate: slots whose EWMA sinks
+        # below the floor stop proposing for the rest of the request (their
+        # draft passes would cost more than the accepted tokens buy).
+        acc = accepted_np.sum(axis=1)
+        self._spec_last_accept = acc
+        self._spec_last_on = spec_on
+        prop = np.where(spec_on, self.chunk * self.spec_k, 0)
+        live = prop > 0
+        if live.any():
+            rate = np.zeros(self.slots, np.float32)
+            rate[live] = acc[live] / prop[live]
+            a = self._spec_alpha
+            self._spec_ewma[live] = ((1.0 - a) * self._spec_ewma[live]
+                                     + a * rate[live])
+            self._spec_on[live] = self._spec_ewma[live] >= self._spec_floor
+        self._spec_proposed_pending += int(prop.sum())
+        self._spec_accepted_pending += int(acc.sum())
+        self._spec_proposed_total += int(prop.sum())
+        self._spec_accepted_total += int(acc.sum())
         return toks
+
+    def _slot_result(self, host_toks, slot: int):
+        if not self._spec or self._last_counts is None:
+            return super()._slot_result(host_toks, slot)
+        counts = self._last_counts[slot]          # [chunk] advances
+        toks = host_toks[slot]                    # [chunk, k+1]
+        out: List[int] = []
+        for t in range(counts.shape[0]):
+            out.extend(int(x) for x in toks[t, :counts[t]])
+        return out, int(counts.sum())
+
+    def _chunk_span_attrs(self, slot: int) -> Optional[Dict]:
+        if (not self._spec or self._last_counts is None
+                or not self._spec_last_on[slot]):
+            return None
+        return {"spec_proposed": self.chunk * self.spec_k,
+                "spec_accepted": int(self._spec_last_accept[slot])}
 
     def _release_slot_device(self, slot: int) -> None:
         ids = self._slot_blocks[slot]
@@ -880,6 +1086,9 @@ class PagedLLMEngine(LLMEngine):
             self._slot_blocks[slot] = []
             self._slot_table[slot, :] = 0
             self.kv.release(ids)
+        if self._spec:
+            self._spec_on[slot] = False
+            self._spec_use_pending[slot] = False
 
     def _on_retire_locked(self, req: _Request) -> None:
         ids = self._slot_blocks[req.slot] if req.slot is not None else []
@@ -1021,6 +1230,12 @@ class PagedLLMEngine(LLMEngine):
     def stats(self) -> Dict[str, float]:
         out = super().stats()
         out.update(self.kv.stats())
+        if self._spec:
+            prop = self._spec_proposed_total
+            acc = self._spec_accepted_total
+            out["spec_proposed_total"] = float(prop)
+            out["spec_accepted_total"] = float(acc)
+            out["spec_accept_ratio"] = float(acc) / prop if prop else 0.0
         return out
 
     def _observe(self, delivered: int, ttfts: List[tuple]) -> None:
@@ -1028,9 +1243,16 @@ class PagedLLMEngine(LLMEngine):
         hits, self._hit_pending = self._hit_pending, 0
         from ray_tpu.core.metrics_export import (metrics_enabled,
                                                  serve_kv_block_occupancy,
-                                                 serve_kv_hit_tokens_total)
+                                                 serve_kv_hit_tokens_total,
+                                                 serve_spec_accept_ratio,
+                                                 serve_spec_accepted_total,
+                                                 serve_spec_proposed_total,
+                                                 serve_ttft_hist)
 
         if not metrics_enabled():
+            if self._spec:
+                self._spec_proposed_pending = 0
+                self._spec_accepted_pending = 0
             return
         tags = {"deployment": self.name}
         if hits:
@@ -1039,6 +1261,25 @@ class PagedLLMEngine(LLMEngine):
         gauge = serve_kv_block_occupancy()
         for state in ("active", "cached", "free"):
             gauge.set(st[f"kv_blocks_{state}"], {**tags, "state": state})
+        if self._spec:
+            prop, self._spec_proposed_pending = self._spec_proposed_pending, 0
+            acc, self._spec_accepted_pending = self._spec_accepted_pending, 0
+            if prop:
+                serve_spec_proposed_total().inc(prop, tags)
+            if acc:
+                serve_spec_accepted_total().inc(acc, tags)
+            tot_prop = self._spec_proposed_total
+            if tot_prop:
+                serve_spec_accept_ratio().set(
+                    self._spec_accepted_total / tot_prop, tags)
+            # The spec dispatch IS the first decode chunk for a first
+            # token delivered this step — surface its propose+verify time
+            # as its own TTFT phase next to queued/prefill/decode.
+            if ttfts and self._last_counts is not None:
+                hist = serve_ttft_hist()
+                for _ in ttfts:
+                    hist.observe(self._spec_last_dt,
+                                 {**tags, "phase": "spec"})
 
     def device_metrics(self, *, prompt_len: int = 16, reps: int = 10) -> Dict:
         import jax
@@ -1159,15 +1400,19 @@ class DisaggregatedLLMEngine:
         self.chunk = chunk
         self.max_queue = int(max_queue if max_queue is not None
                              else knobs.serve_admission_queue_limit)
+        # spec_tokens=0: disaggregated decode admits via KV handoff, where
+        # draft-side KV never exists — speculation is a colocated-engine
+        # feature.
         self.decode = PagedLLMEngine(
             params, config, max_len=max_len, prompt_buckets=prompt_buckets,
             chunk=chunk, slots=slots, max_queue=0, name=name,
-            block_tokens=block_tokens, pool_blocks=pool_blocks)
+            block_tokens=block_tokens, pool_blocks=pool_blocks,
+            spec_tokens=0)
         self.prefill = PagedLLMEngine(
             params, config, max_len=max_len, prompt_buckets=prompt_buckets,
             chunk=chunk, slots=max(1, prefill_slots), max_queue=0,
             name=f"{name}-prefill", block_tokens=block_tokens,
-            pool_blocks=pool_blocks)
+            pool_blocks=pool_blocks, spec_tokens=0)
         self.slots = self.decode.slots
         self.finish_reason = "stop"  # single-stream convenience, as LLMEngine
 
@@ -1442,6 +1687,8 @@ def llm_deployment(
     slots: Optional[int] = None,
     chunk: int = 8,
     max_queue: Optional[int] = None,
+    draft_config: Optional[TransformerConfig] = None,
+    draft_params_fn: Optional[Callable[[], Dict]] = None,
     **deployment_kwargs,
 ):
     """Build a Serve deployment class around a continuous-batching
@@ -1487,14 +1734,21 @@ def llm_deployment(
             # back to the PR 8 slotted engine, serve_disaggregation_enabled=1
             # splits prefill from decode over a KV handoff lane.
             eng_knobs = _get_config()
+            eng_kw = {}
             if bool(eng_knobs.serve_disaggregation_enabled):
                 cls = DisaggregatedLLMEngine
             elif bool(eng_knobs.serve_kv_paged_enabled):
                 cls = PagedLLMEngine
+                if draft_params_fn is not None:
+                    # Draft weights load in-replica like the target's —
+                    # speculation turns on when serve_spec_tokens > 0.
+                    eng_kw["draft_params"] = draft_params_fn()
+                    eng_kw["draft_config"] = draft_config
             else:
                 cls = LLMEngine
             self.engine = cls(params_fn(), config, slots=n_slots,
-                              chunk=chunk, max_queue=q_limit, name=name)
+                              chunk=chunk, max_queue=q_limit, name=name,
+                              **eng_kw)
             self.engine.warmup()
 
         def __call__(self, payload):
