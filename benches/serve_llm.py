@@ -20,9 +20,16 @@ traffic — the workload paged KV + prefix reuse targets:
 Paged rows record the measured cache hit rate; the headline metrics are
 ``speedup_tokens_vs_slotted`` and ``ttft_p50_speedup_vs_slotted``.
 
+Round 4 (ISSUE 17): cluster-wide KV tier A/B on two engines sharing one
+tier — cross-replica hit rate with the tier on vs the per-replica
+baseline, cold-engine first-request TTFT from the store vs recompute for
+a ≥4-block chain, and a mid-run drain migration (victim → survivor over
+the KV handoff lane) with token-identical post-drain streams.
+
 ``--quick`` is the serve smoke path: a short A/B, a paged-engine COW-fork
-smoke, and a deploy through ``llm_deployment`` streaming concurrent
-requests over the full data plane (handle → pow-2 router → replica).
+smoke, a KV-tier spill/fetch/migrate round trip, and a deploy through
+``llm_deployment`` streaming concurrent requests over the full data plane
+(handle → pow-2 router → replica).
 
 Usage:: python benches/serve_llm.py [--quick] [--round 2]
 """
@@ -499,6 +506,271 @@ def smoke_dataplane(concurrency: int = 4, reps: int = 2) -> dict:
     return row
 
 
+def bench_kv_tier_modes(reps: int, slots: int, chunk: int) -> List[dict]:
+    """ISSUE 17 round 4: cluster-wide KV tier A/B on two engines sharing
+    one tier (the in-process stand-in for two replicas + the object store).
+
+    Three measurements:
+
+    - **Hit rate** — a 2-turn session mix whose turn 2 lands on the OTHER
+      engine (rebalanced routing, the cross-replica reuse case): with the
+      tier off every cross hit is a full re-prefill (per-replica hit rate);
+      with it on, turn 2 pulls the spilled chain from the store
+      (cluster-wide hit rate).
+    - **Cold-engine TTFT** — a chain ≥4 blocks long spilled by engine A;
+      a COLD engine's first-request TTFT fetching it from the store vs a
+      tier-less engine recomputing the same prefix (the warm-up headline:
+      fetch must beat recompute when prefill compute dominates).
+    - **Drain migration** — victim ships its chains over the handoff lane
+      to the survivor mid-run and retires; the survivor's turn-2 streams
+      are asserted TOKEN-IDENTICAL to the victim's own (pre-drain) output
+      and attribute their hits to ``migrated``.
+    """
+    import jax  # noqa: F401 — device probe via _model
+
+    from ray_tpu.core.config import Config, set_config
+    from ray_tpu.core.config import config as get_config
+    from ray_tpu.serve import kv_tier
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg, params, on_tpu = _model(mid=True)
+    bt = int(get_config().serve_kv_block_tokens)
+    prev_cfg = get_config()
+    results: List[dict] = []
+    platform = "tpu" if on_tpu else "cpu"
+
+    def mk(name: str) -> PagedLLMEngine:
+        eng = PagedLLMEngine(params, cfg, chunk=chunk, slots=slots,
+                             max_queue=0, name=name)
+        eng.warmup()
+        return eng
+
+    def timed_stream(eng, prompt, n):
+        t0 = time.perf_counter()
+        first = None
+        toks = []
+        for tok in eng.stream(list(prompt), max_new_tokens=n):
+            if first is None:
+                first = time.perf_counter() - t0
+            toks.append(tok)
+        return toks, first
+
+    def session_mix(tier_on: bool) -> dict:
+        kv_tier.reset_local_backend()
+        set_config(Config({"kv_tier_enabled": tier_on}))
+        a, b = mk("hit-a"), mk("hit-b")
+        n_sessions = 2 * max(2, reps // 2)
+        t1_len = 5 * bt // 2  # 2 full blocks + half a block of turn 1
+        hist = []
+        for i in range(n_sessions):
+            p = [(i * 17 + j * 3) % 250 + 1 for j in range(t1_len)]
+            eng = a if i % 2 == 0 else b
+            hist.append(list(p) + eng.generate(list(p), max_new_tokens=8))
+        ttfts = []
+        for i, h in enumerate(hist):
+            eng = b if i % 2 == 0 else a  # turn 2 on the OTHER replica
+            _toks, first = timed_stream(eng, h + [9, 9], 8)
+            ttfts.append(first)
+        hit = miss = store = 0.0
+        for eng in (a, b):
+            st = eng.kv.stats()
+            hit += st["kv_hit_tokens"]
+            miss += st["kv_miss_tokens"]
+            if tier_on:
+                es = eng.stats()
+                store += es["kv_tier_hits_store"]
+                store += es["kv_tier_hits_migrated"]
+        spilled = sum(e.stats().get("kv_tier_spilled_blocks", 0.0)
+                      for e in (a, b)) if tier_on else 0.0
+        a.close()
+        b.close()
+        return {
+            "metric": "serve_llm_kv_tier_hit_rate",
+            "mode": "cluster_tier" if tier_on else "per_replica",
+            "sessions": n_sessions, "slots": slots, "chunk": chunk,
+            # Cluster-wide rate counts store/migrated-sourced tokens as
+            # hits; the per-replica baseline can only count local ones.
+            "hit_rate": round((hit + store) / max(1.0, hit + miss), 3),
+            "kv_tier_hit_tokens": store,
+            "kv_tier_spilled_blocks": spilled,
+            "ttft_ms_p50_turn2": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 2),
+            "platform": platform,
+        }
+
+    try:
+        base = session_mix(tier_on=False)
+        tier = session_mix(tier_on=True)
+        if base["hit_rate"] > 0:
+            tier["hit_rate_vs_per_replica"] = round(
+                tier["hit_rate"] / base["hit_rate"], 2)
+        assert tier["hit_rate"] > base["hit_rate"], \
+            "cluster tier did not beat the per-replica hit rate"
+        for row in (base, tier):
+            print(json.dumps(row), flush=True)
+            results.append(row)
+
+        # -- cold-engine warm-up: store fetch vs recompute, chain >= 4
+        # blocks. Model sized so prefill COMPUTE dominates the fixed
+        # per-request cost (decode chunk + scheduling) — the regime the
+        # warm-up path targets; a toy config would drown the prefill
+        # saving in dispatch noise.
+        from ray_tpu.models import transformer
+
+        cold_cfg = transformer.tiny(d_model=384, n_layers=6, n_heads=8,
+                                    d_ff=1536, max_seq_len=256)
+        cold_params = transformer.init_params(cold_cfg, jax.random.key(0))
+        chain_blocks = 8
+        long_p = [(j * 11 + 7) % 250 + 1
+                  for j in range(chain_blocks * bt + 4)]
+
+        def mk_cold(name: str) -> PagedLLMEngine:
+            # Two buckets only: the full-prompt one (recompute pays it)
+            # and the short-suffix one (the fetch path's prefill).
+            eng = PagedLLMEngine(cold_params, cold_cfg, chunk=2, slots=2,
+                                 max_queue=0, name=name,
+                                 prompt_buckets=(16, 256))
+            eng.warmup()
+            return eng
+
+        kv_tier.reset_local_backend()
+        set_config(Config({"kv_tier_enabled": True}))
+        warm = mk_cold("cold-src")
+        out_warm = warm.generate(list(long_p), max_new_tokens=8)
+        fetch_ttfts, recompute_ttfts = [], []
+        n_rounds = max(2, min(4, reps // 2))
+        for r in range(n_rounds):
+            cold = mk_cold(f"cold-fetch-{r}")
+            toks, first = timed_stream(cold, long_p, 8)
+            assert toks == out_warm, "store-fetched decode diverged"
+            assert cold.stats()["kv_tier_hits_store"] >= chain_blocks * bt, \
+                "cold engine did not fetch the spilled chain"
+            cold.close()
+            fetch_ttfts.append(first)
+        set_config(Config({"kv_tier_enabled": False}))
+        for r in range(n_rounds):
+            cold = mk_cold(f"cold-recompute-{r}")
+            toks, first = timed_stream(cold, long_p, 8)
+            assert toks == out_warm, "recompute decode diverged"
+            cold.close()
+            recompute_ttfts.append(first)
+        set_config(Config({"kv_tier_enabled": True}))
+        warm.close()
+        fetch_ms = round(float(np.percentile(fetch_ttfts, 50)) * 1e3, 2)
+        recompute_ms = round(
+            float(np.percentile(recompute_ttfts, 50)) * 1e3, 2)
+        row = {
+            "metric": "serve_llm_kv_tier_cold_ttft",
+            "chain_blocks": chain_blocks, "prompt_tokens": len(long_p),
+            "ttft_ms_p50_store_fetch": fetch_ms,
+            "ttft_ms_p50_recompute": recompute_ms,
+            "fetch_speedup_vs_recompute": round(recompute_ms / fetch_ms, 2),
+            "platform": platform,
+        }
+        print(json.dumps(row), flush=True)
+        results.append(row)
+
+        # -- drain migration: victim -> survivor over the handoff lane
+        kv_tier.reset_local_backend()
+        victim, survivor = mk("drain-victim"), mk("drain-survivor")
+        n_sessions = 4
+        t1_len = 3 * bt
+        hist, baseline_t2 = [], []
+        for i in range(n_sessions):
+            p = [(i * 13 + j * 5) % 250 + 1 for j in range(t1_len)]
+            h = list(p) + victim.generate(list(p), max_new_tokens=8)
+            hist.append(h)
+        for h in hist:  # the victim's own turn 2: the identity baseline
+            baseline_t2.append(victim.generate(h + [9, 9],
+                                               max_new_tokens=8))
+        got: dict = {}
+        th = threading.Thread(target=lambda: got.setdefault(
+            "n", survivor.kv_migrate_in("bench-kvdrain")))
+        th.start()
+        sent = victim.kv_migrate_out("bench-kvdrain")
+        th.join()
+        victim.close()  # retire AFTER the chains shipped
+        assert sent >= 1 and got.get("n", 0) >= 1, "drain moved no chains"
+        ttfts = []
+        for i, h in enumerate(hist):
+            toks, first = timed_stream(survivor, h + [9, 9], 8)
+            assert toks == baseline_t2[i], \
+                "post-drain stream diverged from the victim's own output"
+            ttfts.append(first)
+        mig_hits = survivor.stats()["kv_tier_hits_migrated"]
+        assert mig_hits > 0, "survivor attributed no hits to migration"
+        survivor.close()
+        row = {
+            "metric": "serve_llm_kv_tier_drain",
+            "sessions": n_sessions, "chains_migrated": got["n"],
+            "kv_tier_hits_migrated": mig_hits,
+            "ttft_ms_p50_turn2_after_drain": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 2),
+            "quality": "token_identical_across_drain",
+            "platform": platform,
+        }
+        print(json.dumps(row), flush=True)
+        results.append(row)
+    finally:
+        set_config(prev_cfg)
+        kv_tier.reset_local_backend()
+    return results
+
+
+def smoke_kv_tier() -> dict:
+    """Quick smoke: spill → cross-engine store fetch round trip, plus one
+    drain-migrated session, token-identical throughout."""
+    from ray_tpu.core.config import Config, set_config
+    from ray_tpu.core.config import config as get_config
+    from ray_tpu.serve import kv_tier
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg, params, _on_tpu = _model()
+    prev_cfg = get_config()
+    set_config(Config({"kv_tier_enabled": True}))
+    kv_tier.reset_local_backend()
+    try:
+        kw = dict(chunk=4, slots=2, max_queue=0)
+        a = PagedLLMEngine(params, cfg, name="smoke-tier-a", **kw)
+        a.warmup()
+        b = PagedLLMEngine(params, cfg, name="smoke-tier-b", **kw)
+        b.warmup()
+        p = [(7 * j + 3) % 250 + 1 for j in range(32)]
+        out_a = a.generate(list(p), max_new_tokens=6)
+        out_b = b.generate(list(p), max_new_tokens=6)
+        assert out_a == out_b, "store-fetched decode diverged"
+        store_hits = b.stats()["kv_tier_hits_store"]
+        assert store_hits > 0, "no cluster-wide hit on the shared prompt"
+        got: dict = {}
+        th = threading.Thread(target=lambda: got.setdefault(
+            "n", b.kv_migrate_in("smoke-kvdrain")))
+        th.start()
+        sent = a.kv_migrate_out("smoke-kvdrain")
+        th.join()
+        assert sent >= 1 and got.get("n", 0) >= 1, "migration moved nothing"
+        a.close()
+        h = list(p) + out_a + [9]
+        out_t2 = b.generate(h, max_new_tokens=6)
+        assert out_t2, "post-drain turn 2 produced nothing"
+        mig_hits = b.stats()["kv_tier_hits_migrated"]
+        b.close()
+        backend_stats = kv_tier._local_backend().stats()
+        assert backend_stats["prefix_dir_refs"] == 0, \
+            "directory refs leaked after both engines closed"
+        row = {
+            "metric": "serve_llm_kv_tier_smoke",
+            "kv_tier_store_hits": store_hits,
+            "chains_migrated": got["n"],
+            "kv_tier_hits_migrated": mig_hits,
+            "ok": True,
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        set_config(prev_cfg)
+        kv_tier.reset_local_backend()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -515,7 +787,13 @@ def main() -> int:
         results = bench_modes([4], reps=2, slots=4, chunk=args.chunk)
         results += bench_prefix_modes([4], reps=2, slots=4, chunk=args.chunk)
         results.append(smoke_paged_cow())
+        results.append(smoke_kv_tier())
         results.append(smoke_dataplane())
+    elif args.round >= 4:
+        # Round 4 (ISSUE 17): cluster-wide KV tier A/B — cross-replica hit
+        # rate, cold-engine warm-up from the store, drain migration.
+        results = bench_kv_tier_modes(reps=args.reps, slots=args.slots,
+                                      chunk=args.chunk)
     elif args.round >= 3:
         # Round 3 (ISSUE 16): speculative-decoding TPOT A/B on the paged
         # engine — decode-heavy traffic, equal (asserted-identical) quality.

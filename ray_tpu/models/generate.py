@@ -1059,6 +1059,55 @@ class KVBlockManager:
                     return hit_len + t
             return hit_len
 
+    def pin(self, block_ids: Sequence[int]) -> None:
+        """Refcount-bump blocks the caller already holds ids for (CACHED ->
+        ACTIVE as needed) — the KV-tier spill/migrate paths pin a retired
+        chain before copying it off-device so eviction can't race the
+        extract."""
+        with self._lock:
+            for b in block_ids:
+                if self._ref.get(b, 0) == 0:
+                    self._cached.pop(b, None)
+                self._ref[b] = self._ref.get(b, 0) + 1
+
+    def pin_chain(self, tokens: Sequence[int],
+                  n_real: int) -> Tuple[List[int], int]:
+        """Pin a registered chain EXACTLY as :meth:`register_chain` laid it
+        out: every full block of ``tokens[:n_real]`` plus the exact partial
+        tail entry. Unlike :meth:`lookup` (whose ``len - 1`` cap can never
+        see a chain's own full-length tail), this is the export walk for
+        spill/migration. Returns ``(pinned_ids, covered_tokens)`` — empty
+        when even the first block is gone (counters untouched; not a serving
+        lookup)."""
+        from ray_tpu.util import blockhash
+
+        bt = self.block_tokens
+        n_full = n_real // bt
+        digests = blockhash.block_hashes(tokens[:n_real], bt,
+                                         max_blocks=n_full)
+        with self._lock:
+            ids: List[int] = []
+            parent = blockhash.SEED
+            for d in digests:
+                b = self._by_hash.get(d)
+                if b is None:
+                    break
+                ids.append(b)
+                parent = d
+            covered = len(ids) * bt
+            if len(ids) == n_full and n_real > covered:
+                key = (parent, tuple(int(x) for x in
+                                     tokens[covered:n_real]))
+                b = self._tail_by_key.get(key)
+                if b is not None:
+                    ids.append(b)
+                    covered = n_real
+            for b in ids:
+                if self._ref.get(b, 0) == 0:
+                    self._cached.pop(b, None)      # CACHED -> ACTIVE
+                self._ref[b] = self._ref.get(b, 0) + 1
+            return ids, covered
+
     def note_cow(self) -> None:
         with self._lock:
             self.cow_copies += 1

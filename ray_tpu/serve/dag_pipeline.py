@@ -27,6 +27,7 @@ from __future__ import annotations
 import itertools
 import struct
 import threading
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -200,6 +201,27 @@ class KVHandoffLane:
         self.chan = Channel(name=name, capacity=capacity, create=create,
                             slots=slots)
         self.name = self.chan.name
+
+    @classmethod
+    def attach(cls, name: str, timeout: float = 10.0,
+               capacity: int = 8 * 1024 * 1024,
+               slots: Optional[int] = None) -> Optional["KVHandoffLane"]:
+        """Attach to a lane some OTHER endpoint creates, retrying until it
+        appears or ``timeout`` lapses (None on timeout). The KV-tier drain
+        path races lane creation against attachment — the survivor creates,
+        the retiring victim attaches — so the attach side polls instead of
+        requiring create-before-attach ordering. ``capacity``/``slots``
+        must MATCH the creator's (the shm mapping is sized from them; both
+        drain endpoints derive them from the same model config)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return cls(name=name, capacity=capacity, slots=slots,
+                           create=False)
+            except Exception:  # noqa: BLE001 — shm segment not there yet
+                if time.monotonic() > deadline:
+                    return None
+                time.sleep(0.01)
 
     # -- writer half (prefill engine) -----------------------------------------
     def send(self, meta: dict, k: np.ndarray, v: np.ndarray,
