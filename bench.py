@@ -817,6 +817,191 @@ def run_control_plane(quick: bool = False) -> None:
     print(json.dumps({"metric": "control_plane", **results}))
 
 
+def run_sched_sim_child(arm: str, nodes: int, quick: bool) -> None:
+    """One gang-scheduling arm over the in-process SimCluster (fresh
+    interpreter; the parent resolved this arm's flags into env). Three
+    measurements per arm: cross-tier edges of a slice-sized gang on the
+    empty cluster, then gang create latency p50/p99 + churn throughput at
+    ~60% utilization, then raw lease-cycle scheduler throughput."""
+    from ray_tpu.core.sim_cluster import SimCluster
+
+    hosts_per_slice = 16
+    # Slice-sized gang: one full-host bundle per host in a slice, so the
+    # topology-aware planner can land it DCN-free and the blind one can't.
+    slice_gang = [{"CPU": 16.0}] * hosts_per_slice
+    # Churn gang: 16 x quarter-host bundles (4 nodes' worth).
+    churn_gang = [{"CPU": 4.0}] * 16
+    churn = 40 if quick else 200
+    lease_cycles = 300 if quick else 2000
+
+    cluster = SimCluster(nodes, cpus_per_node=16, tpus_per_node=4, seed=0)
+    try:
+        pg = cluster.create_gang(slice_gang, strategy="PACK")
+        edges = cluster.gang_cross_tier_edges(pg)
+        cluster.remove_gang(pg)
+
+        # Fill to ~60% so churn placement works a realistically loaded
+        # scheduler, then steady-state: remove the oldest gang, time the
+        # create that replaces it.
+        fill = max(1, int(nodes * 0.6) // 4)
+        live = [cluster.create_gang(churn_gang) for _ in range(fill)]
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(churn):
+            cluster.remove_gang(live.pop(0))
+            t1 = time.perf_counter()
+            live.append(cluster.create_gang(churn_gang))
+            lat.append(time.perf_counter() - t1)
+        churn_dt = time.perf_counter() - t0
+
+        t2 = time.perf_counter()
+        for _ in range(lease_cycles):
+            lease_id, _nid, _addr = cluster.svc.request_lease(
+                {"CPU": 1.0}, None, 30.0)
+            cluster.svc.release_lease(lease_id)
+        lease_dt = time.perf_counter() - t2
+    finally:
+        cluster.shutdown()
+
+    lat.sort()
+    print(json.dumps({
+        "arm": arm,
+        "nodes": nodes,
+        "cross_tier_edges": edges,
+        "gang_create_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "gang_create_p99_ms": round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3),
+        "gang_cycles_per_s": round(churn / churn_dt, 1),
+        "lease_cycles_per_s": round(lease_cycles / lease_dt, 1),
+    }))
+
+
+def run_sched_sim_watchdog(nodes: int) -> None:
+    """Watchdog-detection measurement: a node's heartbeats stop silently
+    (SIGKILL posture, nothing declared) and we time how long the GCS
+    health loop takes to mark it dead. Short health periods come from the
+    parent's env so the number is about the detection path, not the
+    default 5s budget."""
+    from ray_tpu.core.sim_cluster import SimCluster, wait_for
+
+    cluster = SimCluster(nodes, cpus_per_node=16, tpus_per_node=4, seed=0)
+    try:
+        victim = cluster.daemons[nodes // 2]
+        # Let a couple of heartbeat rounds land so the victim is healthy.
+        assert wait_for(lambda: cluster.svc.heartbeat(victim.node_id) == "ok",
+                        timeout=10.0)
+        cluster.stop_heartbeat(nodes // 2)
+        t0 = time.perf_counter()
+        detected = wait_for(
+            lambda: victim.node_id in cluster.svc._dead_nodes, timeout=30.0)
+        dt = time.perf_counter() - t0
+    finally:
+        cluster.shutdown()
+    print(json.dumps({
+        "nodes": nodes,
+        "watchdog_detected": detected,
+        "watchdog_detection_s": round(dt, 3),
+    }))
+
+
+def run_sched_sim(quick: bool = False) -> None:
+    """Gang-scheduling-at-scale A/B over the simulated control plane
+    (``ray_tpu.core.sim_cluster``): the topology-aware atomic gang path vs
+    the per-bundle 2PC baseline it replaces, at 300-1000 stub-daemon nodes
+    with real lease tables and live heartbeats. Records gang-placement
+    latency p50/p99, gang churn + lease-cycle throughput, cross-tier-edge
+    counts vs a topology-blind arm, and watchdog detection time in
+    ``BENCH_sched_r01.json``. Each arm runs in a fresh interpreter with its
+    flags resolved from env at boot, exactly as a deployed GCS would."""
+    nodes = 64 if quick else 1000
+
+    arm_env = {
+        # Atomic topology-aware gang placement (the round-18 path).
+        "gang": {"RAY_TPU_GANG_SCHEDULING_ENABLED": "1",
+                 "RAY_TPU_TOPOLOGY_LABELS": "auto"},
+        # Legacy per-bundle 2PC placement (gang scheduling off).
+        "baseline": {"RAY_TPU_GANG_SCHEDULING_ENABLED": "0"},
+        # Atomic gang reservation but topology-blind packing: isolates the
+        # ICI-locality scoring's contribution to cross-tier edges.
+        "blind": {"RAY_TPU_GANG_SCHEDULING_ENABLED": "1",
+                  "RAY_TPU_TOPOLOGY_LABELS": "off"},
+    }
+
+    def trial(arm: str) -> dict:
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "RAY_TPU_LOG_LEVEL": "WARNING"})
+        env.update(arm_env[arm])
+        r = subprocess.run(
+            [sys.executable, __file__, "--sched-sim-child", arm, str(nodes)]
+            + (["--quick"] if quick else []),
+            capture_output=True, text=True, timeout=600, env=env)
+        if r.returncode != 0:
+            print(json.dumps({"metric": "sched_sim",
+                              "error": (r.stderr or "")[-400:]}))
+            sys.exit(1)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # Alternating order + medians for the two timed arms; the blind arm
+    # only contributes its (deterministic) cross-tier edge count.
+    order = (("gang", "baseline") if quick
+             else ("gang", "baseline", "baseline", "gang",
+                   "gang", "baseline"))
+    trials = {"gang": [], "baseline": []}
+    for arm in order:
+        trials[arm].append(trial(arm))
+    blind = trial("blind")
+
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "RAY_TPU_LOG_LEVEL": "WARNING",
+                "RAY_TPU_HEALTH_CHECK_PERIOD_S": "0.2",
+                "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD": "3",
+                "RAY_TPU_SIM_HEARTBEAT_PERIOD_S": "0.1"})
+    wd_nodes = nodes if quick else 300
+    r = subprocess.run(
+        [sys.executable, __file__, "--sched-sim-watchdog", str(wd_nodes)],
+        capture_output=True, text=True, timeout=600, env=env)
+    if r.returncode != 0:
+        print(json.dumps({"metric": "sched_sim",
+                          "error": (r.stderr or "")[-400:]}))
+        sys.exit(1)
+    watchdog = json.loads(r.stdout.strip().splitlines()[-1])
+
+    def median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    results = {"nodes": nodes, "hosts_per_slice": 16,
+               "trials_per_arm": len(trials["gang"])}
+    for arm in ("gang", "baseline"):
+        for key in ("gang_create_p50_ms", "gang_create_p99_ms",
+                    "gang_cycles_per_s", "lease_cycles_per_s"):
+            results[f"{key}_{arm}"] = median(
+                [t[key] for t in trials[arm]])
+    results["cross_tier_edges_topology_aware"] = median(
+        [t["cross_tier_edges"] for t in trials["gang"]])
+    results["cross_tier_edges_blind"] = blind["cross_tier_edges"]
+    results["watchdog_nodes"] = watchdog["nodes"]
+    results["watchdog_detection_s"] = watchdog["watchdog_detection_s"]
+    results["speedup"] = round(
+        results["gang_cycles_per_s_gang"]
+        / results["gang_cycles_per_s_baseline"], 2)
+    results["p99_ratio"] = round(
+        results["gang_create_p99_ms_baseline"]
+        / results["gang_create_p99_ms_gang"], 2)
+    results["meets_2x_target"] = (results["speedup"] >= 2.0
+                                  or results["p99_ratio"] >= 2.0)
+    if not quick:
+        # --quick is the CI smoke (64 nodes, 1 trial): schema check only,
+        # never overwrite the published at-scale artifact.
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_sched_r01.json")
+        with open(out, "w") as f:
+            json.dump({"results": results}, f, indent=1)
+    print(json.dumps({"metric": "sched_sim", **results}))
+
+
 def run_slo(quick: bool = False) -> None:
     """SLO-driven autoscaling bench: the open-loop load harness
     (``benches/loadgen.py``) sweeps offered load against fixed-1 / fixed-N /
@@ -901,6 +1086,15 @@ if __name__ == "__main__":
                                 int(sys.argv[i + 3]))
     elif "--control-plane" in sys.argv:
         run_control_plane(quick="--quick" in sys.argv)
+    elif "--sched-sim-child" in sys.argv:
+        i = sys.argv.index("--sched-sim-child")
+        run_sched_sim_child(sys.argv[i + 1], int(sys.argv[i + 2]),
+                            quick="--quick" in sys.argv)
+    elif "--sched-sim-watchdog" in sys.argv:
+        i = sys.argv.index("--sched-sim-watchdog")
+        run_sched_sim_watchdog(int(sys.argv[i + 1]))
+    elif "--sched-sim" in sys.argv:
+        run_sched_sim(quick="--quick" in sys.argv)
     elif "--slo" in sys.argv:
         run_slo(quick="--quick" in sys.argv)
     elif "--rl" in sys.argv:

@@ -123,6 +123,33 @@ class Config:
     # node's CPU count (reference: worker_pool.cc prestart).
     prestart_workers_per_node = _Flag(4)
 
+    # -- gang scheduling / topology -------------------------------------------
+    # Topology-aware atomic gang placement: multi-bundle PACK/STRICT_PACK
+    # placement groups are planned as one all-or-nothing reservation over
+    # pinned cap-N capacity blocks, packed into a single ICI slice when one
+    # has room (STRICT_PACK refuses to spill; PACK spills onto the fewest
+    # slices). 0 reproduces the legacy per-bundle 2PC path exactly.
+    gang_scheduling_enabled = _Flag(True)
+    # Node topology labeling mode: "auto" honors daemon-supplied topo.pod /
+    # topo.slice / topo.tier labels (unlabeled nodes become singleton
+    # slices); "off" makes the gang planner topology-blind (atomic
+    # reservation kept, ICI-locality scoring skipped).
+    topology_labels = _Flag("auto")
+    # Preemption classes: serve autoscaling under SLO pressure may revoke
+    # gangs whose gang_priority is strictly lower than the requester's,
+    # through the capacity-block revocation path. 0 disables preemption;
+    # placement and priorities are still recorded.
+    gang_preemption_enabled = _Flag(True)
+    # Simulated-cluster harness (core/sim_cluster.py): hosts per synthetic
+    # ICI slice when fabricating topology labels for stub daemons.
+    sim_hosts_per_slice = _Flag(16)
+    # Simulated-cluster harness: slices per synthetic pod.
+    sim_slices_per_pod = _Flag(4)
+    # Simulated-cluster harness: stub-daemon heartbeat period. Keep well
+    # under health_check_period_s * health_check_failure_threshold or the
+    # watchdog will declare sim nodes dead.
+    sim_heartbeat_period_s = _Flag(0.5)
+
     # -- memory monitor / OOM policy (memory_monitor.h:52 analog) -------------
     # Node memory-usage fraction above which the daemon kills the newest
     # busy TASK worker (retriable-FIFO policy). >=1.0 disables.

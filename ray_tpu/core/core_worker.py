@@ -3306,12 +3306,21 @@ class CoreWorker:
     # ====================== placement groups ======================
 
     def create_placement_group(self, pg_id, bundles, strategy, name="",
-                               timeout: float = 60.0) -> bool:
+                               timeout: float = 60.0,
+                               gang_priority: int = 0) -> bool:
         return self._gcs_rpc.call("create_placement_group", pg_id, name,
-                                  bundles, strategy, timeout, timeout=None)
+                                  bundles, strategy, timeout, gang_priority,
+                                  timeout=None)
 
     def remove_placement_group(self, pg_id) -> None:
         self._gcs_rpc.call("remove_placement_group", pg_id)
+
+    def preempt_gangs(self, resources, count: int = 1,
+                      min_priority: int = 0) -> int:
+        """Revoke lower-class gangs so ``count`` units of ``resources``
+        could be placed (serve autoscaling under SLO pressure)."""
+        return self._gcs_rpc.call("preempt_gangs", dict(resources),
+                                  int(count), int(min_priority))
 
     def get_placement_group(self, pg_id) -> Optional[dict]:
         return self._gcs_rpc.call("get_placement_group", pg_id)

@@ -76,16 +76,22 @@ class _CapacityBlock:
     # one node, carved into per-task worker leases by that node's daemon
     # (lease ids "cap-N#k"). client_id scopes the block to the requesting
     # client like _Lease — a client death reclaims the un-returned units.
+    # pg_id (when set) marks a GANG block: it backs one node's share of an
+    # atomic placement-group reservation, its units are owned by the PG's
+    # bundle accounting (never returned by the idle sweep or client-death
+    # reclaim), and it leaves only through remove/preempt/node-death.
     __slots__ = ("block_id", "node_id", "shape", "total", "returned",
-                 "client_id")
+                 "client_id", "pg_id")
 
-    def __init__(self, block_id, node_id, shape, total, client_id=""):
+    def __init__(self, block_id, node_id, shape, total, client_id="",
+                 pg_id=None):
         self.block_id = block_id
         self.node_id = node_id
         self.shape = shape  # ResourceSet of ONE unit
         self.total = total
         self.returned = 0
         self.client_id = client_id
+        self.pg_id = pg_id
 
 
 class _Bundle:
@@ -98,14 +104,21 @@ class _Bundle:
 
 
 class _PlacementGroup:
-    __slots__ = ("pg_id", "name", "strategy", "bundles", "state")
+    # gang_priority is the preemption class: serve autoscaling under SLO
+    # pressure may revoke gangs of strictly lower priority. seq orders
+    # same-priority victims (newest preempted first — least sunk work).
+    __slots__ = ("pg_id", "name", "strategy", "bundles", "state",
+                 "gang_priority", "seq")
 
-    def __init__(self, pg_id, name, strategy, bundles):
+    def __init__(self, pg_id, name, strategy, bundles, gang_priority=0,
+                 seq=0):
         self.pg_id = pg_id
         self.name = name
         self.strategy = strategy
         self.bundles: List[_Bundle] = bundles
         self.state = "CREATED"
+        self.gang_priority = int(gang_priority)
+        self.seq = seq
 
 
 class GcsService:
@@ -150,6 +163,11 @@ class GcsService:
         self._blocks: Dict[str, _CapacityBlock] = {}
         self._next_block = 0
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
+        self._pg_seq = 0
+        # Placement groups removed while their creation was still mid-wait:
+        # the creating thread checks this at each retry and rolls back
+        # instead of committing a reservation nobody will ever release.
+        self._pg_tombstones = BoundedSet()
         # Object directory (locations + lineage + per-task live sets),
         # hash-partitioned by creating-task key across gcs_shards lock
         # domains so location storms stop contending with scheduling.
@@ -540,6 +558,12 @@ class GcsService:
                 raise RuntimeError(
                     f"placement group {pg_id} does not exist "
                     "(removed?)")
+            if self._pgs[pg_id].state == "PREEMPTED":
+                # A higher-priority gang revoked this group's reservation —
+                # fail fast so the client recreates instead of spinning out
+                # the whole timeout.
+                raise RuntimeError(
+                    f"placement group {pg_id} was preempted")
         if _client_id and _client_id in self._dead_clients:
             # Grant-after-death race: the client's cleanup already
             # ran while this handler was blocked — granting now
@@ -663,6 +687,12 @@ class GcsService:
             block = self._blocks.get(block_id)
             if block is None:
                 return False
+            if block.pg_id is not None:
+                # Gang blocks back a live placement-group reservation; the
+                # PG's bundle accounting owns those units (daemons pin them
+                # out of the idle sweep, so reaching here means a confused
+                # daemon — refuse the return, keep the record).
+                return True
             n = max(0, min(int(n), block.total - block.returned))
             if n:
                 block.returned += n
@@ -690,6 +720,11 @@ class GcsService:
         out: List[Dict[str, float]] = []
         with self._lock:
             for block in self._blocks.values():
+                if block.pg_id is not None:
+                    # Gang blocks are PG reservations, not pending lease
+                    # capacity — counting them would skew the autoscaler
+                    # (legacy PG reservations were never counted here).
+                    continue
                 units = block.total - block.returned
                 if units <= 0:
                     continue
@@ -816,28 +851,225 @@ class GcsService:
 
     def create_placement_group(self, pg_id: PlacementGroupID, name: str,
                                bundles: List[Dict[str, float]], strategy: str,
-                               timeout: float = 60.0) -> bool:
+                               timeout: float = 60.0,
+                               gang_priority: int = 0) -> bool:
         """Atomic multi-bundle reservation.
 
         The reference needs prepare/commit across raylets
         (``gcs_placement_group_scheduler.h:113-115``); with centralized
         accounting the transaction is a single critical section, with the
         same all-or-nothing outcome (rollback on partial fit).
+
+        With ``gang_scheduling_enabled``, multi-bundle PACK/STRICT_PACK
+        groups take the topology-aware GANG path instead: one planner pass
+        places the whole group (inside a single ICI slice when possible —
+        STRICT_PACK becomes strict-one-slice rather than strict-one-node),
+        then every node's share is reserved as a pinned revocable ``cap-N``
+        capacity block — commit or roll back, no partial gangs. SPREAD
+        strategies and single bundles keep the legacy path, as does
+        ``gang_scheduling_enabled=0`` (bit-for-bit the old behavior).
         """
         requests = [ResourceSet(b) for b in bundles]
         deadline = time.time() + timeout
+        use_gang = (config().gang_scheduling_enabled
+                    and strategy in ("PACK", "STRICT_PACK")
+                    and len(requests) > 1)
+        t0 = time.monotonic()
+        pushes: List[tuple] = []
         with self._lock:
             while True:
-                placed = self._try_place_bundles(requests, strategy)
-                if placed is not None:
-                    pg = _PlacementGroup(pg_id, name, strategy,
-                                         [_Bundle(r, n) for r, n in zip(requests, placed)])
-                    self._pgs[pg_id] = pg
-                    return True
+                if pg_id in self._pg_tombstones:
+                    # Removed while we waited: commit would leak.
+                    self._pg_tombstones.discard(pg_id)
+                    flightrec.record("pg", pg_id.hex()[:16],
+                                     "gang.rollback (removed mid-create)"
+                                     if use_gang else
+                                     "rollback (removed mid-create)")
+                    raise RuntimeError(
+                        f"placement group {pg_id} was removed during "
+                        "creation")
+                if use_gang:
+                    got = self._try_place_gang(pg_id, name, requests,
+                                               strategy, gang_priority)
+                    if got is not None:
+                        pushes = got
+                        break
+                else:
+                    placed = self._try_place_bundles(requests, strategy)
+                    if placed is not None:
+                        self._pg_seq += 1
+                        pg = _PlacementGroup(
+                            pg_id, name, strategy,
+                            [_Bundle(r, n) for r, n in zip(requests, placed)],
+                            gang_priority=gang_priority, seq=self._pg_seq)
+                        self._pgs[pg_id] = pg
+                        break
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise TimeoutError(f"cannot place bundles {bundles} ({strategy})")
                 self._sched_cv.wait(timeout=min(remaining, 1.0))
+        # Push the gang's pinned blocks to their daemons OUTSIDE the lock
+        # (best-effort, like the batch-lease adopt push: a lost push only
+        # loses daemon-side observability, the GCS accounting is already
+        # committed).
+        for addr, block_id, shape, total in pushes:
+            try:
+                self._daemons.get(addr).notify(
+                    "adopt_capacity_block", block_id, shape, total, True)
+            except Exception:  # noqa: BLE001 — GCS accounting already holds
+                log_swallowed(logger, "gang block adopt push")
+        from ray_tpu.core.metrics_export import (gang_placement_hist,
+                                                 metrics_enabled)
+        if metrics_enabled():
+            gang_placement_hist().observe(
+                time.monotonic() - t0,
+                {"path": "gang" if use_gang else "2pc"})
+        return True
+
+    def _try_place_gang(self, pg_id, name, requests: List[ResourceSet],
+                        strategy: str, gang_priority: int):
+        """One atomic gang attempt; caller holds self._lock. Returns the
+        daemon adopt-push list on commit, None when the gang doesn't fit
+        anywhere (nothing allocated)."""
+        topo = config().topology_labels != "off"
+        assignment = self.scheduler.plan_gang(
+            requests, topology_aware=topo,
+            strict_slice=(strategy == "STRICT_PACK" and topo))
+        if assignment is None:
+            return None
+        nodeset = sorted({n.hex()[:8] for n in assignment})
+        flightrec.record("pg", pg_id.hex()[:16],
+                         f"gang.reserve n={len(requests)} "
+                         f"nodes={','.join(nodeset)}")
+        # Reserve every bundle; all-or-nothing (the plan worked over a
+        # snapshot, so a concurrent grant can still race us — roll back and
+        # let the retry loop replan).
+        placed: List[tuple] = []
+        for req, node_id in zip(requests, assignment):
+            if not self.scheduler.try_allocate(node_id, req):
+                for n, r in placed:
+                    self.scheduler.release(n, r)
+                flightrec.record("pg", pg_id.hex()[:16],
+                                 "gang.rollback (lost allocation race)")
+                return None
+            placed.append((node_id, req))
+        # The reservation currency: one pinned revocable cap-N block per
+        # (node, bundle shape) — the unit preemption revokes.
+        groups: Dict[tuple, list] = {}
+        for req, node_id in zip(requests, assignment):
+            key = (node_id, tuple(sorted(req._fixed.items())))
+            if key in groups:
+                groups[key][1] += 1
+            else:
+                groups[key] = [req, 1]
+        pushes: List[tuple] = []
+        for (node_id, _shape_key), (req, count) in groups.items():
+            self._next_block += 1
+            block_id = f"cap-{self._next_block}"
+            self._blocks[block_id] = _CapacityBlock(
+                block_id, node_id, req, count, pg_id=pg_id)
+            addr = self._node_addr.get(node_id)
+            if addr:
+                pushes.append((addr, block_id, req.to_dict(), count))
+        self._pg_seq += 1
+        pg = _PlacementGroup(
+            pg_id, name, strategy,
+            [_Bundle(r, n) for r, n in zip(requests, assignment)],
+            gang_priority=gang_priority, seq=self._pg_seq)
+        self._pgs[pg_id] = pg
+        flightrec.record("pg", pg_id.hex()[:16],
+                         f"gang.commit blocks={len(groups)} "
+                         f"prio={gang_priority} nodes={','.join(nodeset)}")
+        return pushes
+
+    def _gang_blocks_locked(self, pg_id) -> List[_CapacityBlock]:
+        return [b for b in self._blocks.values() if b.pg_id == pg_id]
+
+    def _drop_gang_blocks_locked(self, pg_id) -> List[Tuple[str, str]]:
+        """Forget a gang's blocks WITHOUT releasing resources (the bundle
+        accounting owns the units); returns (block_id, daemon addr) revoke
+        targets for the caller to notify outside the lock."""
+        revokes: List[Tuple[str, str]] = []
+        for block in self._gang_blocks_locked(pg_id):
+            self._blocks.pop(block.block_id, None)
+            addr = self._node_addr.get(block.node_id)
+            if addr:
+                revokes.append((block.block_id, addr))
+        return revokes
+
+    def _notify_revokes(self, revokes: List[Tuple[str, str]],
+                        why: str) -> None:
+        for block_id, addr in revokes:
+            flightrec.record("lease", block_id, f"revoke ({why})")
+            try:
+                self._daemons.get(addr).notify("revoke_capacity_block",
+                                               block_id)
+            except Exception:  # noqa: BLE001 — daemon death has its own path
+                log_swallowed(logger, "gang block revoke push")
+
+    def preempt_gangs(self, resources: Dict[str, float], count: int = 1,
+                      min_priority: int = 0) -> int:
+        """Revoke lower-class gangs until ``count`` units of ``resources``
+        could be placed (the serve-autoscaling SLO-pressure path, riding
+        the capacity-block revocation plumbing). Victims: strictly lower
+        ``gang_priority`` than ``min_priority``, lowest class first, newest
+        first within a class (least sunk work). Returns gangs preempted;
+        0 when capacity already suffices or preemption is disabled."""
+        if not config().gang_preemption_enabled:
+            return 0
+        request = ResourceSet(resources)
+        count = max(1, int(count))
+        preempted: List[_PlacementGroup] = []
+        revokes: List[Tuple[str, str]] = []
+        with self._lock:
+            def can_fit_all() -> bool:
+                # Tentatively allocate all units, then roll back — the only
+                # exact cumulative-fit check.
+                got: List[NodeID] = []
+                for _ in range(count):
+                    nid = self.scheduler.best_node(request)
+                    if nid is None or not self.scheduler.try_allocate(
+                            nid, request):
+                        break
+                    got.append(nid)
+                for nid in got:
+                    self.scheduler.release(nid, request)
+                return len(got) >= count
+
+            if can_fit_all():
+                return 0
+            victims = sorted(
+                (pg for pg in self._pgs.values()
+                 if pg.state in ("CREATED", "RESCHEDULING")
+                 and pg.gang_priority < min_priority),
+                key=lambda pg: (pg.gang_priority, -pg.seq))
+            for pg in victims:
+                pg.state = "PREEMPTED"
+                for b in pg.bundles:
+                    # Dead-node bundles of RESCHEDULING victims are already
+                    # off the books; release() no-ops for unknown nodes.
+                    self.scheduler.release(b.node_id, b.resources)
+                    b.in_use = ResourceSet()
+                revokes.extend(self._drop_gang_blocks_locked(pg.pg_id))
+                preempted.append(pg)
+                flightrec.record(
+                    "pg", pg.pg_id.hex()[:16],
+                    f"gang.preempt prio={pg.gang_priority} "
+                    f"nodes={','.join(sorted({b.node_id.hex()[:8] for b in pg.bundles}))}")
+                if can_fit_all():
+                    break
+            if preempted:
+                self._wake_shapes_locked()
+        self._notify_revokes(revokes, "preempt")
+        if preempted:
+            from ray_tpu.core.metrics_export import (gang_preemptions_total,
+                                                     metrics_enabled)
+            if metrics_enabled():
+                gang_preemptions_total().inc(len(preempted))
+            logger.warning(
+                "preempted %d gang(s) below priority %d for %s x%d",
+                len(preempted), min_priority, resources, count)
+        return len(preempted)
 
     def _try_place_bundles(self, requests: List[ResourceSet], strategy: str):
         # Tentatively allocate; roll back on any failure (the 2PC outcome).
@@ -922,10 +1154,18 @@ class GcsService:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
+                # Creation may still be mid-wait (2PC retry in flight):
+                # tombstone the id so that create rolls back instead of
+                # committing a reservation nobody will ever release.
+                self._pg_tombstones.add(pg_id)
                 return
-            for b in pg.bundles:
-                self.scheduler.release(b.node_id, b.resources)
+            revokes = self._drop_gang_blocks_locked(pg_id)
+            if pg.state != "PREEMPTED":
+                # Preemption already released the bundle reservations.
+                for b in pg.bundles:
+                    self.scheduler.release(b.node_id, b.resources)
             self._wake_shapes_locked()
+        self._notify_revokes(revokes, "pg remove")
 
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[dict]:
         with self._lock:

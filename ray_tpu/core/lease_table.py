@@ -39,15 +39,20 @@ def block_of(lease_id: str) -> str:
 
 class _BlockState:
     __slots__ = ("block_id", "shape", "free", "in_use", "next_seq",
-                 "revoked", "last_activity")
+                 "revoked", "pinned", "last_activity")
 
-    def __init__(self, block_id: str, shape: Dict[str, float], total: int):
+    def __init__(self, block_id: str, shape: Dict[str, float], total: int,
+                 pinned: bool = False):
         self.block_id = block_id
         self.shape = dict(shape)
         self.free = int(total)
         self.in_use: set = set()
         self.next_seq = 0
         self.revoked = False
+        # Pinned blocks back a gang placement-group reservation: the idle
+        # sweep must never ship their units back to the GCS (the bundle
+        # accounting there still owns them). They leave only via revoke.
+        self.pinned = bool(pinned)
         self.last_activity = time.monotonic()
 
 
@@ -58,14 +63,17 @@ class LocalLeaseTable:
         self._lock = threading.Lock()
         self._blocks: Dict[str, _BlockState] = {}
 
-    def adopt(self, block_id: str, shape: Dict[str, float], total: int) -> None:
+    def adopt(self, block_id: str, shape: Dict[str, float], total: int,
+              pinned: bool = False) -> None:
         """Record a GCS-granted block. Idempotent — the grant may arrive both
-        as a GCS push and as the first client carve's inline hint."""
+        as a GCS push and as the first client carve's inline hint. Gang
+        bundle blocks arrive ``pinned`` (exempt from the idle sweep)."""
         with self._lock:
             if block_id in self._blocks:
                 return
-            self._blocks[block_id] = _BlockState(block_id, shape, total)
-        flightrec.record("lease", block_id, f"adopt x{int(total)}")
+            self._blocks[block_id] = _BlockState(block_id, shape, total, pinned)
+        flightrec.record("lease", block_id,
+                         f"adopt x{int(total)}" + (" pinned" if pinned else ""))
 
     def carve(self, block_id: str, shape: Optional[Dict[str, float]] = None,
               total: Optional[int] = None) -> Optional[str]:
@@ -125,7 +133,7 @@ class LocalLeaseTable:
         out: List[Tuple[str, int]] = []
         with self._lock:
             for st in list(self._blocks.values()):
-                if st.revoked or st.free <= 0:
+                if st.revoked or st.pinned or st.free <= 0:
                     continue
                 if now - st.last_activity > ttl_s:
                     out.append((st.block_id, st.free))
@@ -150,7 +158,8 @@ class LocalLeaseTable:
         with self._lock:
             return {
                 bid: {"shape": dict(st.shape), "free": st.free,
-                      "in_use": len(st.in_use), "revoked": st.revoked}
+                      "in_use": len(st.in_use), "revoked": st.revoked,
+                      "pinned": st.pinned}
                 for bid, st in self._blocks.items()
             }
 

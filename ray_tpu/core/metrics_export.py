@@ -86,6 +86,21 @@ def mirror_stats_gauge(name: str, desc: str, stats: Dict[str, float]) -> None:
         g.set(float(val), {"counter": key})
 
 
+def gang_placement_hist() -> um.Histogram:
+    """Gang placement latency, reserve→commit, tagged by planner path
+    (``gang`` atomic block reservation vs ``2pc`` legacy per-bundle)."""
+    return _metric(
+        um.Histogram, "ray_tpu_gang_placement_s",
+        "Placement-group gang placement latency (reserve to commit)",
+        boundaries=_LATENCY_BOUNDS, tag_keys=("path",))
+
+
+def gang_preemptions_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_gang_preemptions_total",
+                   "Gangs revoked to make room for higher gang_priority "
+                   "capacity (serve SLO pressure)")
+
+
 def task_phase_hist() -> um.Histogram:
     return _metric(
         um.Histogram, "ray_tpu_task_phase_s",

@@ -881,10 +881,12 @@ class NodeDaemon:
     # ============ daemon-local lease table (capacity blocks) ============
 
     def adopt_capacity_block(self, block_id: str, shape: Dict[str, float],
-                             total: int) -> None:
+                             total: int, pinned: bool = False) -> None:
         """GCS pushes a fresh block grant (best-effort; the client's first
-        lease_worker_block carries the same hint inline)."""
-        self._lease_table.adopt(block_id, shape, int(total))
+        lease_worker_block carries the same hint inline). ``pinned`` blocks
+        back a gang placement-group reservation: the idle sweep must never
+        ship their units back — they leave only via revoke."""
+        self._lease_table.adopt(block_id, shape, int(total), pinned=pinned)
 
     def revoke_capacity_block(self, block_id: str) -> None:
         """GCS reclaimed the block (client death): stop carving; in-flight

@@ -48,9 +48,17 @@ class PlacementGroupState:
     bundles: List[Bundle]
     strategy: str
     name: str = ""
-    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | PREEMPTED
     ready_event: threading.Event = field(default_factory=threading.Event)
     waiters: List[Callable[[], None]] = field(default_factory=list)
+    # Preemption class: higher-priority capacity demand may revoke lower.
+    gang_priority: int = 0
+    seq: int = 0  # creation order; newest-first victim pick within a class
+    # Retry index: the DISTINCT bundle shapes (and, for STRICT_PACK, the
+    # single-node total) this group needs. retry_pending's wake filter —
+    # a release that leaves some shape unfittable can't have unblocked us.
+    distinct_shapes: List[ResourceSet] = field(default_factory=list)
+    total_shape: Optional[ResourceSet] = None
 
 
 class PlacementGroupManager:
@@ -65,16 +73,33 @@ class PlacementGroupManager:
         self.runtime = runtime
         self._lock = threading.RLock()
         self.groups: Dict[PlacementGroupID, PlacementGroupState] = {}
+        self._seq = 0
+        # Shape-filter effectiveness counters, same shape as the GCS lease
+        # plane's wake index: a "skip" is a pending group NOT re-placed on
+        # a release because some bundle shape still fits nowhere.
+        self.wake_stats = {"wakes": 0, "skips": 0}
 
-    def create(self, bundles: List[Dict[str, float]], strategy: str, name: str = "") -> PlacementGroupState:
+    def create(self, bundles: List[Dict[str, float]], strategy: str,
+               name: str = "", gang_priority: int = 0) -> PlacementGroupState:
         pg_id = PlacementGroupID.from_random()
+        distinct: Dict[tuple, ResourceSet] = {}
+        total = ResourceSet({})
+        for b in bundles:
+            rs = ResourceSet(b)
+            distinct[tuple(sorted(b.items()))] = rs
+            total = total + rs
         state = PlacementGroupState(
             pg_id=pg_id,
             bundles=[Bundle(i, dict(b)) for i, b in enumerate(bundles)],
             strategy=strategy,
             name=name,
+            gang_priority=int(gang_priority),
+            distinct_shapes=list(distinct.values()),
+            total_shape=total if strategy == "STRICT_PACK" else None,
         )
         with self._lock:
+            self._seq += 1
+            state.seq = self._seq
             self.groups[pg_id] = state
             self._try_place_locked(state)
         self._flush_waiters(state)
@@ -95,6 +120,11 @@ class PlacementGroupManager:
         placed: List[tuple] = []  # (node_id, ResourceSet)
 
         def commit():
+            if state.state != "PENDING":
+                # Removed while this retry was mid-flight (the 2PC race):
+                # committing would strand the reservations forever — undo.
+                rollback()
+                return
             for b in state.bundles:
                 b.available = ResourceSet(b.resources)
             state.state = "CREATED"
@@ -152,16 +182,83 @@ class PlacementGroupManager:
 
         raise PlacementGroupError(f"unknown strategy {strategy}")
 
+    def _could_place_locked(self, g: PlacementGroupState) -> bool:
+        """Cheap necessary condition before the full 2PC attempt: every
+        distinct bundle shape must fit on SOME node right now (and, for
+        STRICT_PACK, the summed total on one node). A CPU release storm
+        then never walks a TPU gang's full placement loop."""
+        sched = self.runtime.scheduler
+        if g.total_shape is not None:
+            return sched.any_can_fit(g.total_shape)
+        return all(sched.any_can_fit(s) for s in g.distinct_shapes)
+
     def retry_pending(self) -> None:
         flushed: List[PlacementGroupState] = []
         with self._lock:
             for g in self.groups.values():
-                if g.state == "PENDING":
-                    self._try_place_locked(g)
-                    if g.state == "CREATED":
-                        flushed.append(g)
+                if g.state != "PENDING":
+                    continue
+                if not self._could_place_locked(g):
+                    self.wake_stats["skips"] += 1
+                    continue
+                self.wake_stats["wakes"] += 1
+                self._try_place_locked(g)
+                if g.state == "CREATED":
+                    flushed.append(g)
         for g in flushed:
             self._flush_waiters(g)
+
+    def preempt_lower(self, resources: Dict[str, float], count: int = 1,
+                      min_priority: int = 0) -> int:
+        """Revoke gangs of strictly lower ``gang_priority`` until ``count``
+        units of ``resources`` could be placed (in-process analog of the
+        GCS ``preempt_gangs`` RPC). Lowest class first, newest first within
+        a class. Returns the number of groups preempted."""
+        from ray_tpu.core.config import config
+        from ray_tpu.util import flightrec
+
+        if not config().gang_preemption_enabled:
+            return 0
+        sched = self.runtime.scheduler
+        request = ResourceSet(resources)
+        count = max(1, int(count))
+        preempted = 0
+        with self._lock:
+            def can_fit_all() -> bool:
+                got: List[NodeID] = []
+                for _ in range(count):
+                    nid = sched.best_node(request)
+                    if nid is None or not sched.try_allocate(nid, request):
+                        break
+                    got.append(nid)
+                for nid in got:
+                    sched.release(nid, request)
+                return len(got) >= count
+
+            if can_fit_all():
+                return 0
+            victims = sorted(
+                (g for g in self.groups.values()
+                 if g.state == "CREATED" and g.gang_priority < min_priority),
+                key=lambda g: (g.gang_priority, -g.seq))
+            for g in victims:
+                g.state = "PREEMPTED"
+                for b in g.bundles:
+                    if b.node_id is not None:
+                        sched.release(b.node_id, ResourceSet(b.resources))
+                        b.node_id = None
+                flightrec.record("pg", g.pg_id.hex()[:16],
+                                 f"gang.preempt prio={g.gang_priority}")
+                preempted += 1
+                if can_fit_all():
+                    break
+        if preempted:
+            from ray_tpu.core.metrics_export import (gang_preemptions_total,
+                                                     metrics_enabled)
+            if metrics_enabled():
+                gang_preemptions_total().inc(preempted)
+            self.runtime._on_resources_freed()
+        return preempted
 
     def when_ready(self, pg_id: PlacementGroupID, callback: Callable[[], None]) -> bool:
         """Run callback once the group is CREATED (now, or on placement).
@@ -170,7 +267,7 @@ class PlacementGroupManager:
         """
         with self._lock:
             state = self.groups.get(pg_id)
-            if state is None or state.state == "REMOVED":
+            if state is None or state.state in ("REMOVED", "PREEMPTED"):
                 return False
             if state.state == "PENDING":
                 state.waiters.append(callback)
@@ -330,8 +427,14 @@ def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",
     name: str = "",
+    gang_priority: int = 0,
 ) -> PlacementGroup:
-    """Create a placement group (reference: util/placement_group.py:145)."""
+    """Create a placement group (reference: util/placement_group.py:145).
+
+    ``gang_priority`` is the preemption class: under SLO pressure, serve
+    autoscaling may revoke groups of strictly lower priority (see
+    ``gang_preemption_enabled``). Default 0 = preemptible by anything.
+    """
     if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
         raise ValueError(f"invalid strategy {strategy}")
     if not bundles:
@@ -339,9 +442,11 @@ def placement_group(
     rt = get_runtime()
     if hasattr(rt, "create_placement_group"):  # multiprocess CoreWorker
         pg_id = PlacementGroupID.from_random()
-        rt.create_placement_group(pg_id, bundles, strategy, name)
+        rt.create_placement_group(pg_id, bundles, strategy, name,
+                                  gang_priority=gang_priority)
         return DistributedPlacementGroup(pg_id)
-    state = _manager().create(bundles, strategy, name)
+    state = _manager().create(bundles, strategy, name,
+                              gang_priority=gang_priority)
     return PlacementGroup(state.pg_id)
 
 

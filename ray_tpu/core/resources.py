@@ -13,9 +13,60 @@ exactly how the reference's TPU accelerator manager emits them
 
 from __future__ import annotations
 
-from typing import Dict, Iterable
+from typing import Dict, Iterable, Sequence, Tuple
 
 PRECISION = 10_000  # 1e-4 resource granularity, same as fixed_point.h
+
+# -- interconnect topology vocabulary -----------------------------------------
+#
+# The two-tier network model every layer shares: ranks inside one TPU slice
+# talk over ICI (cheap, high-bandwidth collectives); anything that crosses a
+# slice boundary rides the data-center network. ``parallel/mesh.py`` maps
+# mesh axes onto these same tier names (AXIS_TIER), and the gang scheduler
+# scores candidate placements by how many bundle pairs are forced onto DCN.
+# Defined here (not in parallel/) so the GCS process never imports jax.
+TIER_ICI = "ici"
+TIER_DCN = "dcn"
+
+# Node-label keys carrying a node's position in the fabric. A daemon that
+# knows its TPU metadata registers with all three; unlabeled nodes degrade
+# to one-node slices (every gang edge between them is a DCN edge).
+TOPO_POD = "topo.pod"
+TOPO_SLICE = "topo.slice"
+TOPO_TIER = "topo.tier"
+
+
+def topology_labels(pod: str, slice_id: str, tier: str = TIER_ICI) -> Dict[str, str]:
+    """Label dict placing a node at ``(pod, slice, tier)`` in the fabric."""
+    return {TOPO_POD: str(pod), TOPO_SLICE: str(slice_id), TOPO_TIER: str(tier)}
+
+
+def topology_of(labels: Dict[str, str], fallback: str = "") -> Tuple[str, str, str]:
+    """A node's ``(pod, slice, tier)`` from its labels.
+
+    Unlabeled nodes each become a singleton slice named after ``fallback``
+    (callers pass the node id) in a shared default pod — the topology-blind
+    degenerate where no two nodes share ICI.
+    """
+    pod = labels.get(TOPO_POD, "pod0")
+    slice_id = labels.get(TOPO_SLICE) or f"solo:{fallback}"
+    tier = labels.get(TOPO_TIER, TIER_ICI)
+    return pod, slice_id, tier
+
+
+def cross_tier_edges(slice_ids: Sequence[str]) -> int:
+    """Number of unordered bundle pairs landing in DIFFERENT slices.
+
+    Each such pair's collective traffic must cross the DCN tier; 0 means the
+    gang is fully ICI-contained. This is the bin-packing score the gang
+    planner minimizes and the sim harness publishes.
+    """
+    counts: Dict[str, int] = {}
+    for s in slice_ids:
+        counts[s] = counts.get(s, 0) + 1
+    n = len(slice_ids)
+    same = sum(c * (c - 1) // 2 for c in counts.values())
+    return n * (n - 1) // 2 - same
 
 
 def _to_fixed(value: float) -> int:

@@ -979,6 +979,16 @@ class Runtime:
             for n in list(self.nodes.values()):
                 n.dispatch()
 
+    def preempt_gangs(self, resources: Dict[str, float], count: int = 1,
+                      min_priority: int = 0) -> int:
+        """Revoke placement groups of strictly lower gang_priority until
+        ``count`` units of ``resources`` could be placed (the serve
+        SLO-pressure hook; GCS-backed runtimes route this to the
+        ``preempt_gangs`` RPC instead)."""
+        if self._pg_manager is None:
+            return 0
+        return self._pg_manager.preempt_lower(resources, count, min_priority)
+
     # -- generators -----------------------------------------------------------
 
     def next_generator_item(self, task_id: TaskID, index: int) -> Optional[ObjectRef]:

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import NodeID
-from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.resources import NodeResources, ResourceSet, topology_of
 from ray_tpu.core.task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
@@ -88,6 +88,140 @@ class ClusterResourceScheduler:
         predicate there (it also returns feasible-but-busy nodes)."""
         with self._lock:
             return any(nr.can_fit(request) for nr in self._nodes.values())
+
+    # -- gang planning ---------------------------------------------------------
+
+    def node_slice(self, node_id: NodeID) -> str:
+        """The ICI slice a node belongs to (singleton slice if unlabeled)."""
+        with self._lock:
+            nr = self._nodes.get(node_id)
+            if nr is None:
+                return f"solo:{node_id}"
+            return topology_of(nr.labels, fallback=str(node_id))[1]
+
+    def plan_gang(
+        self,
+        requests: List[ResourceSet],
+        topology_aware: bool = True,
+        strict_slice: bool = False,
+    ) -> Optional[List[NodeID]]:
+        """Plan nodes for a multi-bundle gang, minimizing cross-tier edges.
+
+        Pure planning over a snapshot of current availability — the caller
+        commits with per-bundle ``try_allocate`` (rolling back on a lost
+        race). Topology-aware mode packs the whole gang into ONE slice when
+        any slice has room (zero DCN edges), otherwise spills greedily onto
+        the fewest slices, preferring pods already used. ``strict_slice``
+        makes single-slice fit a hard requirement (STRICT_PACK-of-slices).
+        Blind mode first-fits over utilization-sorted nodes — one linear
+        pass instead of the per-bundle best-node scan the 2PC path does.
+
+        Returns one node per request (in request order) or None.
+        """
+        with self._lock:
+            free: Dict[NodeID, Dict[str, int]] = {
+                nid: dict(nr.available._fixed) for nid, nr in self._nodes.items()
+            }
+            topo = {
+                nid: topology_of(nr.labels, fallback=str(nid))
+                for nid, nr in self._nodes.items()
+            }
+
+        def fits(pool: Dict[str, int], req: ResourceSet) -> bool:
+            return all(pool.get(k, 0) >= v for k, v in req._fixed.items())
+
+        def take(pool: Dict[str, int], req: ResourceSet) -> None:
+            for k, v in req._fixed.items():
+                pool[k] = pool.get(k, 0) - v
+
+        # First-fit-decreasing order: big bundles place first, so a gang of
+        # mixed shapes packs onto the fewest nodes.
+        order = sorted(
+            range(len(requests)),
+            key=lambda i: -sum(requests[i]._fixed.values()),
+        )
+
+        def pack_into(node_ids: List[NodeID], idxs: List[int],
+                      pools: Dict[NodeID, Dict[str, int]],
+                      out: Dict[int, NodeID]) -> List[int]:
+            """FFD the bundles ``idxs`` onto ``node_ids``; mutates pools/out,
+            returns the indices that did not fit."""
+            ranked = sorted(
+                node_ids, key=lambda n: -sum(max(0, v) for v in pools[n].values())
+            )
+            left: List[int] = []
+            for i in idxs:
+                for nid in ranked:
+                    if fits(pools[nid], requests[i]):
+                        take(pools[nid], requests[i])
+                        out[i] = nid
+                        break
+                else:
+                    left.append(i)
+            return left
+
+        if not topology_aware:
+            out: Dict[int, NodeID] = {}
+            if pack_into(list(free.keys()), order, free, out):
+                return None
+            return [out[i] for i in range(len(requests))]
+
+        # Group nodes by slice; remember each slice's pod for spill scoring.
+        slices: Dict[str, List[NodeID]] = {}
+        slice_pod: Dict[str, str] = {}
+        for nid, (pod, slice_id, _tier) in topo.items():
+            slices.setdefault(slice_id, []).append(nid)
+            slice_pod[slice_id] = pod
+
+        def slice_free(sid: str) -> int:
+            return sum(
+                sum(max(0, v) for v in free[n].values()) for n in slices[sid]
+            )
+
+        # Pass 1 — best-fit single slice: among slices that hold the whole
+        # gang, take the one with the least spare capacity (keeps big slices
+        # open for bigger gangs). Zero cross-tier edges by construction.
+        for sid in sorted(slices, key=slice_free):
+            pools = {n: dict(free[n]) for n in slices[sid]}
+            out = {}
+            if not pack_into(slices[sid], order, pools, out):
+                return [out[i] for i in range(len(requests))]
+        if strict_slice:
+            return None
+
+        # Pass 2 — forced spill: repeatedly give the slice that absorbs the
+        # most remaining bundles everything it can hold (fewest, most skewed
+        # slice groups → fewest cross-slice bundle pairs), preferring pods
+        # the gang already landed in so spill stays pod-local.
+        remaining = list(order)
+        out = {}
+        used_pods: set = set()
+        while remaining:
+            best_sid, best_left, best_pools, best_out = None, None, None, None
+            for sid in slices:
+                pools = {n: dict(free[n]) for n in slices[sid]}
+                trial_out: Dict[int, NodeID] = {}
+                left = pack_into(slices[sid], remaining, pools, trial_out)
+                if not trial_out:
+                    continue
+                better = (
+                    best_left is None
+                    or len(left) < len(best_left)
+                    or (len(left) == len(best_left)
+                        and slice_pod[sid] in used_pods
+                        and slice_pod[best_sid] not in used_pods)
+                )
+                if better:
+                    best_sid, best_left = sid, left
+                    best_pools, best_out = pools, trial_out
+            if best_sid is None:
+                return None  # nothing can take even one more bundle
+            for n, pool in best_pools.items():
+                free[n] = pool
+            out.update(best_out)
+            used_pods.add(slice_pod[best_sid])
+            remaining = best_left
+        return [out[i] for i in range(len(requests))]
 
     # -- node selection --------------------------------------------------------
 

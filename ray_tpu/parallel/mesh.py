@@ -28,6 +28,12 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+# Shared tier vocabulary with the control plane: the gang scheduler scores
+# placements by how many bundle pairs are forced onto TIER_DCN, using the
+# same two names these axis assignments use. Defined in core (jax-free) so
+# the GCS process can import it; re-exported here for mesh-side callers.
+from ray_tpu.core.resources import TIER_DCN, TIER_ICI
+
 # Canonical axis order, outermost → innermost (DCN-tolerant → ICI-hungry).
 AXIS_ORDER = ("data", "fsdp", "expert", "pipe", "seq", "tensor")
 
@@ -36,8 +42,8 @@ AXIS_ORDER = ("data", "fsdp", "expert", "pipe", "seq", "tensor")
 # inter-slice DCN; every inner axis demands single-slice ICI latency. The
 # eager host collectives mirror this two-level split at the process level
 # (``ray_tpu.parallel.collectives``: intra-node shm tier + inter-node ring).
-AXIS_TIER = {"data": "dcn", "fsdp": "dcn", "expert": "ici", "pipe": "ici",
-             "seq": "ici", "tensor": "ici"}
+AXIS_TIER = {"data": TIER_DCN, "fsdp": TIER_DCN, "expert": TIER_ICI,
+             "pipe": TIER_ICI, "seq": TIER_ICI, "tensor": TIER_ICI}
 
 
 @dataclass(frozen=True)

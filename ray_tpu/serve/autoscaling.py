@@ -34,9 +34,19 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from ray_tpu.core.config import config
 from ray_tpu.serve.config import AutoscalingConfig
+from ray_tpu.util import flightrec
+from ray_tpu.utils.logging import get_logger
 
-__all__ = ["DeploymentSignals", "SLOPolicy", "TTFTRollup"]
+__all__ = ["DeploymentSignals", "GangPreemption", "SLOPolicy", "TTFTRollup"]
+
+logger = get_logger("serve_autoscaling")
+
+# Serve's preemption class: placement groups created with a lower
+# ``gang_priority`` (RL/Tune training gangs default to 0) may be revoked
+# when a latency-SLO breach needs replica capacity the cluster can't place.
+SERVE_GANG_PRIORITY = 100
 
 
 @dataclass
@@ -173,6 +183,51 @@ class SLOPolicy:
         # Dead-band: inside the hysteresis window, hold steady.
         self._low_since = None
         return current
+
+
+class GangPreemption:
+    """SLO-pressure capacity reclaim: when the policy wants replicas the
+    cluster may not be able to place, revoke lower-class gangs through the
+    control plane's block-revocation path (``preempt_gangs``).
+
+    Pure decision state like :class:`SLOPolicy` — injected time, injected
+    ``preempt`` callable (the runtime RPC in production, a stub in tests).
+    Rate-limited per deployment so one sustained breach doesn't strip every
+    training gang in the cluster on consecutive control ticks; gated by
+    ``gang_preemption_enabled``.
+    """
+
+    def __init__(self, preempt, priority: int = SERVE_GANG_PRIORITY,
+                 min_interval_s: float = 5.0):
+        self.preempt = preempt  # (resources, count, min_priority) -> int
+        self.priority = priority
+        self.min_interval_s = min_interval_s
+        self._last: Dict[str, float] = {}
+
+    def maybe_reclaim(self, deployment: str, shape: Dict[str, float],
+                      count: int, now: Optional[float] = None) -> int:
+        if count <= 0 or self.preempt is None:
+            return 0
+        if not config().gang_preemption_enabled:
+            return 0
+        if now is None:
+            now = time.monotonic()
+        if now - self._last.get(deployment, float("-inf")) < self.min_interval_s:
+            return 0
+        self._last[deployment] = now
+        try:
+            n = int(self.preempt(dict(shape), int(count), self.priority))
+        except Exception:  # noqa: BLE001 — reclaim is advisory, never fatal
+            logger.exception("gang preemption call failed for %s", deployment)
+            return 0
+        if n:
+            flightrec.record("serve", deployment,
+                             f"gang.preempt reclaimed {n} gang(s) "
+                             f"for {count} x {shape}")
+            logger.warning(
+                "SLO pressure on %s: preempted %d lower-priority gang(s) "
+                "to place %d replica(s) of %s", deployment, n, count, shape)
+        return n
 
 
 class TTFTRollup:

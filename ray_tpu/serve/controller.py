@@ -19,14 +19,39 @@ import ray_tpu
 from ray_tpu.core.config import config
 from ray_tpu.util import flightrec
 from ray_tpu.utils.logging import get_logger, log_swallowed
-from ray_tpu.serve.autoscaling import (DeploymentSignals, SLOPolicy,
-                                       TTFTRollup)
+from ray_tpu.serve.autoscaling import (DeploymentSignals, GangPreemption,
+                                       SLOPolicy, TTFTRollup)
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 
 logger = get_logger("serve_controller")
 from ray_tpu.serve.replica import ReplicaActor
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def _runtime_preempt(resources: Dict[str, float], count: int,
+                     min_priority: int) -> int:
+    """Route a gang-preemption request to whichever runtime this controller
+    replica lives in (CoreWorker RPC in multiprocess, the in-process
+    PlacementGroupManager otherwise)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    fn = getattr(get_runtime(), "preempt_gangs", None)
+    return int(fn(resources, count, min_priority)) if fn is not None else 0
+
+
+def _replica_shape(t: "_DeploymentTarget") -> Dict[str, float]:
+    """One replica's resource demand, from its actor options (the shape a
+    preemption must make placeable)."""
+    opts = t.config.ray_actor_options or {}
+    shape: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        shape["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        shape["TPU"] = float(opts["num_tpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        shape[k] = float(v)
+    return shape or {"CPU": 1.0}
 
 
 @dataclass
@@ -54,6 +79,10 @@ class ServeControllerActor:
         # SLO autoscaling state: one policy per deployment (holds the
         # hysteresis/cooldown timers) + the rate-limited TTFT rollup reader.
         self._policies: Dict[str, SLOPolicy] = {}
+        # SLO-pressure capacity reclaim: an upscale decision under a TTFT
+        # breach may revoke lower-gang_priority training gangs through the
+        # runtime's preempt_gangs path before the new replicas try to place.
+        self._gang_preemption = GangPreemption(_runtime_preempt)
         self._ttft = TTFTRollup(
             min_interval_s=config().serve_slo_rollup_interval_s)
         self._last_slo_eval: Dict[str, float] = {}
@@ -332,6 +361,12 @@ class ServeControllerActor:
             policy.drain_single_step = bool(config().kv_tier_enabled)
             sig = self._build_signals(t, asc, now)
             desired = policy.desired(t.target_replicas, sig, now)
+            if desired > t.target_replicas and policy.ttft_violated(sig):
+                # Latency SLO breached AND we're growing: reclaim capacity
+                # from lower-priority gangs so the new replicas can place.
+                self._gang_preemption.maybe_reclaim(
+                    t.name, _replica_shape(t),
+                    desired - t.target_replicas, now)
             if desired != t.target_replicas:
                 logger.info(
                     "autoscale %s: %d -> %d (pressure=%.2f ttft_p99=%s)",
