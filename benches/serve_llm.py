@@ -450,6 +450,51 @@ def smoke_paged_cow() -> dict:
     return row
 
 
+def smoke_jit_warmup() -> dict:
+    """Quick smoke: jitcheck-instrumented warmup — report how many XLA
+    compilations warmup pays and their wall seconds, then assert a warmed
+    mixed burst compiles NOTHING (the steady-state contract the TPOT
+    numbers above rest on)."""
+    import jax
+
+    from ray_tpu.devtools import jitcheck
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    was = jitcheck.installed()
+    if not was:
+        jitcheck.install()
+    try:
+        cfg, params, _on_tpu = _model()
+        t0 = time.perf_counter()
+        n0, s0 = jitcheck.total_compiles(), jitcheck.total_compile_seconds()
+        eng = PagedLLMEngine(params, cfg, chunk=4, slots=2, max_queue=0,
+                             name="smoke-jit")
+        eng.warmup()
+        warm_s = time.perf_counter() - t0
+        warm_compiles = jitcheck.total_compiles() - n0
+        warm_compile_s = jitcheck.total_compile_seconds() - s0
+        for i in range(3):  # mixed burst: greedy + sampled, varied lengths
+            eng.generate([(7 * j + i) % 250 + 1 for j in range(6 + 4 * i)],
+                         max_new_tokens=5, temperature=0.0 if i % 2 else 0.7,
+                         seed=i)
+        steady_compiles = jitcheck.total_compiles() - n0 - warm_compiles
+        assert steady_compiles == 0, (
+            f"warmed engine compiled {steady_compiles}x in steady state")
+        row = {
+            "metric": "serve_llm_jit_warmup_smoke",
+            "warmup_compiles": warm_compiles,
+            "warmup_compile_s": round(warm_compile_s, 3),
+            "warmup_wall_s": round(warm_s, 3),
+            "steady_state_compiles": steady_compiles,
+            "ok": True,
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        if not was:
+            jitcheck.uninstall()
+
+
 def smoke_dataplane(concurrency: int = 4, reps: int = 2) -> dict:
     """Serve smoke: stream concurrent requests through the FULL data plane
     (handle → router → replica actor → engine) and check the contract."""
@@ -788,6 +833,7 @@ def main() -> int:
         results += bench_prefix_modes([4], reps=2, slots=4, chunk=args.chunk)
         results.append(smoke_paged_cow())
         results.append(smoke_kv_tier())
+        results.append(smoke_jit_warmup())
         results.append(smoke_dataplane())
     elif args.round >= 4:
         # Round 4 (ISSUE 17): cluster-wide KV tier A/B — cross-replica hit

@@ -30,6 +30,16 @@ if _os.environ.get("RAY_TPU_LEAK_CHECK_ENABLED", "").lower() in (
 
     _leakcheck.install()
 
+if _os.environ.get("RAY_TPU_JIT_CHECK_ENABLED", "").lower() in (
+        "1", "true", "yes", "on"):
+    # Same top-of-import rule: jax.jit must be wrapped BEFORE the
+    # submodules below import, or their module-level jitted callables
+    # would be untracked (compiles attributed to <untracked>, and the
+    # steady-state guard blind to them).
+    from ray_tpu.devtools import jitcheck as _jitcheck
+
+    _jitcheck.install()
+
 from ray_tpu._version import version as __version__
 from ray_tpu.api import (
     available_resources,

@@ -469,6 +469,13 @@ class Config:
     # site; the test harness snapshots live threads/fds/shm segments per
     # test and fails on anything that survives teardown. Dev/test only.
     leak_check_enabled = _Flag(False)
+    # Opt-in runtime JAX compile-churn guard (ray_tpu.devtools.jitcheck):
+    # jax.jit is wrapped to stamp construction sites and count XLA
+    # compilations per (site, abstract signature); jitcheck.steady_state()
+    # — entered by the serve engine after warmup and by IMPALA after
+    # iteration 1 — records any new compile or implicit device->host read
+    # as a contract violation. Dev/test only.
+    jit_check_enabled = _Flag(False)
 
     # -- TPU ------------------------------------------------------------------
     # Logical chips per host for resource autodetection when no TPU present

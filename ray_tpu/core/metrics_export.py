@@ -131,6 +131,20 @@ def serve_ttft_hist() -> um.Histogram:
         boundaries=_LATENCY_BOUNDS, tag_keys=("deployment", "phase"))
 
 
+def jit_compiles_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_jit_compiles_total",
+                   "XLA compilations observed by jitcheck, by the "
+                   "file:line that constructed the jitted callable",
+                   tag_keys=("site",))
+
+
+def jit_compile_seconds_total() -> um.Counter:
+    return _metric(um.Counter, "ray_tpu_jit_compile_seconds_total",
+                   "Cumulative XLA backend-compile wall seconds observed "
+                   "by jitcheck, by construction site",
+                   tag_keys=("site",))
+
+
 def serve_tokens_total() -> um.Counter:
     return _metric(um.Counter, "ray_tpu_serve_tokens_total",
                    "LLM serving decoded tokens delivered to requests",

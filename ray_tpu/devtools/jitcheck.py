@@ -1,0 +1,436 @@
+"""Runtime JAX compile-churn + steady-state guard — the dynamic half of
+jaxlint.
+
+The static passes (``jit-churn`` / ``host-sync`` in
+``ray_tpu.devtools.jaxlint``) prove that every ``jax.jit`` SITE is cached
+and every hot-path host read is explicit; this module proves it at
+runtime: every compilation is counted and attributed to the ``file:line``
+that constructed the jitted callable, and :func:`steady_state` turns the
+serving/training contract — ZERO new XLA compilations, ZERO implicit
+device→host reads after warmup — into recorded violations instead of a
+silent 10–100× per-token tax.
+
+Enabled, :func:`install`
+
+- wraps ``jax.jit`` so every jitted callable is stamped with the
+  ``file:line`` that constructed it; each call runs with that site on a
+  thread-local stack, so compile events are attributed to their site and
+  per-``(site, abstract signature)`` compile counts accumulate,
+- registers a ``jax.monitoring`` duration listener on
+  ``/jax/core/compile/backend_compile_duration`` — the ground truth for
+  "an XLA compile happened" (tracing without compiling does not fire
+  it) — feeding the ``ray_tpu_jit_compiles_total{site}`` /
+  ``ray_tpu_jit_compile_seconds_total{site}`` counters and a
+  ``jit.compile`` flight-recorder event per compile,
+- wraps the implicit-read surface of ``jax.Array``
+  (``__array__``/``__float__``/``__int__``/``__bool__``/``__index__``/
+  ``item``) with a guard that is inert outside :func:`steady_state`;
+  inside it, any implicit device→host read records a violation with its
+  call site. ``jax.device_get`` is wrapped to mark itself as the ONE
+  sanctioned read, so "batch host reads into one device_get" is
+  enforceable even on the CPU backend, where JAX's own
+  ``transfer_guard`` never fires (host-resident arrays transfer
+  zero-copy).
+
+:func:`steady_state` is a thread-local scope: the paged engine enters it
+around every scheduler step once warmed, IMPALA around every training
+iteration after the first. Inside it a new compilation or an implicit
+host read is recorded in :func:`violations` (and raised at scope exit
+with ``strict=True``); ``jax.transfer_guard_device_to_host("disallow")``
+is layered on for real accelerators, where it also catches reads this
+module cannot see.
+
+Enable with the ``jit_check_enabled`` knob
+(``RAY_TPU_JIT_CHECK_ENABLED=1`` — the env form propagates to spawned
+cluster processes; ``ray_tpu/__init__`` installs at the very top of the
+package import, mirroring lockcheck/leakcheck, so module-level jits are
+stamped too). ``tests/conftest.py`` adds an autouse guard that fails any
+test during which a steady-state violation was recorded.
+
+Caveats (by design):
+
+- jits constructed BEFORE install (jax-internal, third-party library
+  jits) still have their compiles counted, attributed to
+  ``<untracked>``.
+- The abstract signature is computed only for calls that actually
+  compiled — signatures are read off the operands lazily, so the
+  per-call overhead of an installed-but-idle jitcheck is one thread-
+  local push/pop and an integer read.
+- Implicit reads through APIs that bypass the wrapped dunders
+  (``memoryview``, buffer-protocol C extensions) are caught on real
+  devices by the transfer guard, not on CPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "install", "uninstall", "installed", "maybe_install",
+    "steady_state", "SteadyStateViolation",
+    "violations", "clear_violations",
+    "compile_counts", "compile_seconds_by_site",
+    "total_compiles", "total_compile_seconds",
+]
+
+_ENV_KNOB = "RAY_TPU_JIT_CHECK_ENABLED"
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: guards every module-global table below (leaf lock: nothing is acquired
+#: under it and it is never held across user code)
+_lock = threading.Lock()
+
+#: (site, abstract signature) -> number of XLA compiles observed
+_compiles: Dict[Tuple[str, str], int] = {}
+#: site -> cumulative XLA compile seconds
+_compile_seconds: Dict[str, float] = {}
+_total_compiles = 0
+_total_compile_seconds = 0.0
+
+#: recorded steady-state violations (compiles / implicit reads), rendered
+_violations: List[str] = []
+
+_tls = threading.local()
+
+_installed = False
+_listener_registered = False
+
+_REAL_JIT = None
+_REAL_DEVICE_GET = None
+_REAL_ARRAY_METHODS: Dict[str, Any] = {}
+
+#: implicit-read dunders guarded inside steady_state
+_GUARDED_READS = ("__array__", "__float__", "__int__", "__bool__",
+                  "__index__", "item")
+
+
+class SteadyStateViolation(AssertionError):
+    """A steady-state scope saw a new XLA compilation or an implicit
+    device→host read (raised at scope exit when ``strict=True``)."""
+
+
+def _caller_site() -> str:
+    """file:line of the first stack frame outside this module and outside
+    jax/numpy internals — the user code that triggered the event."""
+    here = os.path.normcase(__file__)
+    for frame in traceback.extract_stack()[::-1]:
+        fn = os.path.normcase(frame.filename)
+        if fn == here:
+            continue
+        parts = fn.replace(os.sep, "/").split("/")
+        if "jax" in parts or "jaxlib" in parts or "numpy" in parts:
+            continue
+        return f"{os.path.basename(fn)}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+def _site_stack() -> List[str]:
+    st = getattr(_tls, "sites", None)
+    if st is None:
+        st = _tls.sites = []
+    return st
+
+
+def _steady_depth() -> int:
+    return getattr(_tls, "steady", 0)
+
+
+def _reads_allowed() -> bool:
+    return getattr(_tls, "allow_reads", 0) > 0
+
+
+def _record_violation(text: str) -> None:
+    with _lock:
+        _violations.append(text)
+    try:
+        from ray_tpu.util import flightrec
+
+        flightrec.record("jit", "steady_state", text)
+    # raylint: ignore[swallowed-exception] — deliberate: flight-recorder
+    # unavailability must never break the guarded operation
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _abstract_sig(args: tuple, kwargs: dict) -> str:
+    """Short dtype[shape] rendering of the call's array operands."""
+    parts: List[str] = []
+
+    def leaf(x: Any) -> None:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+        elif isinstance(x, (int, float, bool)):
+            parts.append(repr(x))
+
+    try:
+        import jax
+
+        for leafval in jax.tree_util.tree_leaves((args, kwargs)):
+            leaf(leafval)
+            if len(parts) >= 16:  # keep fingerprints bounded
+                parts.append("...")
+                break
+    except Exception:  # noqa: BLE001 — a sig failure must not break the call
+        return "<unavailable>"
+    return f"({', '.join(parts)})"
+
+
+# -- compile accounting ------------------------------------------------------
+
+
+def _on_duration_event(name: str, dur: float, **_kw) -> None:
+    global _total_compiles, _total_compile_seconds
+    if not _installed or name != _COMPILE_EVENT:
+        return
+    sites = _site_stack()
+    site = sites[-1] if sites else "<untracked>"
+    with _lock:
+        _total_compiles += 1
+        _total_compile_seconds += dur
+        _compile_seconds[site] = _compile_seconds.get(site, 0.0) + dur
+    try:
+        from ray_tpu.util import flightrec
+
+        flightrec.record("jit", site, f"compile {dur * 1e3:.1f}ms")
+    # raylint: ignore[swallowed-exception] — deliberate: observability is
+    # best-effort; a metrics/flightrec failure must not fail the compile
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ray_tpu.core.metrics_export import (jit_compile_seconds_total,
+                                                 jit_compiles_total,
+                                                 metrics_enabled)
+
+        if metrics_enabled():
+            jit_compiles_total().inc(1, {"site": site})
+            jit_compile_seconds_total().inc(dur, {"site": site})
+    # raylint: ignore[swallowed-exception] — deliberate: observability is
+    # best-effort; a metrics/flightrec failure must not fail the compile
+    except Exception:  # noqa: BLE001
+        pass
+    if _steady_depth() > 0:
+        _record_violation(
+            f"XLA compilation inside steady_state (site {site}, "
+            f"{dur * 1e3:.1f}ms) — every program must be compiled at warmup")
+
+
+class _TrackedJit:
+    """A jitted callable stamped with its construction site. Calls run with
+    the site on a thread-local stack (compile attribution); attribute
+    access (``lower``/``trace``/``eval_shape``/…) passes through."""
+
+    __slots__ = ("_jitted", "_site", "__dict__")
+
+    def __init__(self, jitted: Any, site: str):
+        self._jitted = jitted
+        self._site = site
+        for attr in ("__name__", "__qualname__", "__doc__", "__wrapped__"):
+            try:
+                object.__setattr__(self, "__dict__", self.__dict__)
+                self.__dict__[attr] = getattr(jitted, attr)
+            except AttributeError:
+                pass
+
+    def __call__(self, *args, **kwargs):
+        global _total_compiles
+        sites = _site_stack()
+        sites.append(self._site)
+        n0 = _total_compiles
+        try:
+            return self._jitted(*args, **kwargs)
+        finally:
+            sites.pop()
+            if _total_compiles > n0:
+                key = (self._site, _abstract_sig(args, kwargs))
+                with _lock:
+                    _compiles[key] = _compiles.get(key, 0) + 1
+
+    def __getattr__(self, name: str):
+        return getattr(self._jitted, name)
+
+    def __repr__(self) -> str:
+        return f"<jitcheck-tracked {self._jitted!r} from {self._site}>"
+
+
+def _jit(fun=None, *args, **kwargs):
+    site = _caller_site()
+    if fun is None:
+        # jax.jit(static_argnums=...) partial form: defer, stamp on apply.
+        def apply(f):
+            return _TrackedJit(_REAL_JIT(f, *args, **kwargs), site)
+
+        return apply
+    return _TrackedJit(_REAL_JIT(fun, *args, **kwargs), site)
+
+
+# -- implicit-read guard -----------------------------------------------------
+
+
+def _guarded(name: str, orig):
+    def guard(self, *args, **kwargs):
+        if _steady_depth() > 0 and not _reads_allowed():
+            _record_violation(
+                f"implicit device->host read ({name}) inside steady_state "
+                f"at {_caller_site()} — use jax.device_get")
+        return orig(self, *args, **kwargs)
+
+    guard.__name__ = name
+    return guard
+
+
+def _device_get(x):
+    _tls.allow_reads = getattr(_tls, "allow_reads", 0) + 1
+    try:
+        return _REAL_DEVICE_GET(x)
+    finally:
+        _tls.allow_reads -= 1
+
+
+# -- install / uninstall -----------------------------------------------------
+
+
+def install() -> None:
+    """Stamp jit sites, count compiles, arm the steady-state guard.
+    Idempotent."""
+    global _installed, _listener_registered, _REAL_JIT, _REAL_DEVICE_GET
+    if _installed:
+        return
+    import jax
+    import jax.monitoring
+    from jax._src import array as _jarray
+
+    _REAL_JIT = jax.jit
+    _REAL_DEVICE_GET = jax.device_get
+    jax.jit = _jit
+    jax.device_get = _device_get
+    for name in _GUARDED_READS:
+        orig = getattr(_jarray.ArrayImpl, name, None)
+        if orig is None:
+            continue
+        _REAL_ARRAY_METHODS[name] = orig
+        setattr(_jarray.ArrayImpl, name, _guarded(name, orig))
+    if not _listener_registered:
+        # jax.monitoring has no per-listener unregister; register once and
+        # gate on _installed so uninstall/reinstall never double-counts.
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_duration_event)
+        _listener_registered = True
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    import jax
+    from jax._src import array as _jarray
+
+    jax.jit = _REAL_JIT
+    jax.device_get = _REAL_DEVICE_GET
+    for name, orig in _REAL_ARRAY_METHODS.items():
+        setattr(_jarray.ArrayImpl, name, orig)
+    _REAL_ARRAY_METHODS.clear()
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install() -> bool:
+    """Install iff the ``jit_check_enabled`` knob is on (env var first —
+    process entry points run before the config table exists)."""
+    on = os.environ.get(_ENV_KNOB)
+    if on is not None:
+        enabled = on.lower() in ("1", "true", "yes", "on")
+    else:
+        try:
+            from ray_tpu.core.config import config
+
+            enabled = config().jit_check_enabled
+        except Exception:  # noqa: BLE001 — config unavailable: stay off
+            enabled = False
+    if enabled:
+        install()
+    return enabled
+
+
+# -- steady state ------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def steady_state(strict: bool = False):
+    """Scope in which new XLA compilations and implicit device→host reads
+    are contract violations. Thread-local and reentrant; a no-op unless
+    :func:`install` ran. Violations are recorded in :func:`violations`
+    (tests fail via the conftest guard); with ``strict=True`` the scope
+    ALSO raises :class:`SteadyStateViolation` at exit."""
+    if not _installed:
+        yield
+        return
+    import jax
+
+    with _lock:
+        n0 = len(_violations)
+    _tls.steady = _steady_depth() + 1
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    finally:
+        _tls.steady -= 1
+        if strict:
+            with _lock:
+                new = _violations[n0:]
+            if new:
+                raise SteadyStateViolation(
+                    "steady-state contract violated:\n  " + "\n  ".join(new))
+
+
+# -- introspection -----------------------------------------------------------
+
+
+def violations() -> List[str]:
+    with _lock:
+        return list(_violations)
+
+
+def clear_violations() -> None:
+    with _lock:
+        _violations.clear()
+
+
+def compile_counts() -> Dict[Tuple[str, str], int]:
+    """(site, abstract signature) -> compiles observed through tracked
+    jits. Untracked compiles appear only in :func:`total_compiles`."""
+    with _lock:
+        return dict(_compiles)
+
+
+def compile_seconds_by_site() -> Dict[str, float]:
+    with _lock:
+        return dict(_compile_seconds)
+
+
+def total_compiles() -> int:
+    return _total_compiles
+
+
+def total_compile_seconds() -> float:
+    return _total_compile_seconds
+
+
+def reset_counters() -> None:
+    """Zero the compile tables (bench harness bookkeeping)."""
+    global _total_compiles, _total_compile_seconds
+    with _lock:
+        _compiles.clear()
+        _compile_seconds.clear()
+        _total_compiles = 0
+        _total_compile_seconds = 0.0

@@ -75,6 +75,13 @@ Checks
     shutdown-path method, or be ``with``-managed. Function-local sockets/
     fds/mmaps that neither escape nor close in-function are flagged too.
 
+``jit-churn`` / ``host-sync`` / ``key-reuse`` / ``donate-uaf``
+    The JAX-aware tier — per-call ``jax.jit`` reconstruction,
+    data-derived static arguments, implicit device→host syncs inside the
+    declared hot scopes, PRNG key reuse, and reads of donated buffers.
+    Implemented in ``ray_tpu.devtools.jaxlint`` (same AST cache, pragmas
+    and baseline; runtime counterpart: ``ray_tpu.devtools.jitcheck``).
+
 Baseline workflow
 =================
 Findings are fingerprinted WITHOUT line numbers
@@ -709,6 +716,18 @@ class Linter:
         self._timed("thread-leak", self._check_thread_leaks)
         self._timed("resource-leak", self._check_resource_leaks)
         self._timed("config-knob", self._check_config_knobs)
+        # The JAX-aware checks live in devtools.jaxlint (imported lazily:
+        # jaxlint imports Finding from this module at its top level) and
+        # ride the same AST cache, pragmas and baseline.
+        from ray_tpu.devtools import jaxlint
+        self._timed("jit-churn",
+                    lambda: jaxlint.check_jit_churn(self, parsed))
+        self._timed("host-sync",
+                    lambda: jaxlint.check_host_sync(self, parsed))
+        self._timed("key-reuse",
+                    lambda: jaxlint.check_key_reuse(self, parsed))
+        self._timed("donate-uaf",
+                    lambda: jaxlint.check_donate_uaf(self, parsed))
         self._assign_fingerprints()
         self.findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
         self.timings["total"] = time.perf_counter() - t0

@@ -1252,6 +1252,10 @@ class Generator:
                     nxt = jax.random.categorical(sub, last_real / temperature, axis=-1)
                 else:
                     nxt = jnp.argmax(last_real, axis=-1)
+                # Accepted host-sync finding (lint baseline): this is the
+                # single-sequence oracle/debug path — one token per yield
+                # IS the contract, so the per-token sync stays. Batched
+                # serving goes through the engines, which fetch per chunk.
                 yield int(nxt[0])
                 if pos >= self.max_len:
                     return

@@ -109,6 +109,9 @@ class CQL:
             hidden=tuple(config.hidden))
         self.module = SACModule(self.spec, low, high,
                                 hidden=tuple(config.hidden))
+        # jitted eval forward, built lazily on the first evaluate() and
+        # cached — rebuilding jax.jit per call recompiles every time
+        self._eval_fwd = None
         self.learner = CQLLearner(self.module, {
             "lr": config.lr, "gamma": config.gamma, "tau": config.tau,
             "cql_alpha": config.cql_alpha,
@@ -151,7 +154,9 @@ class CQL:
                  num_episodes: int = 5, seed: int = 0) -> Dict[str, float]:
         """Mean-policy rollout in a real env."""
         env = env_creator()
-        fwd = jax.jit(self.module.forward_inference)
+        if self._eval_fwd is None:
+            self._eval_fwd = jax.jit(self.module.forward_inference)
+        fwd = self._eval_fwd
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=seed + ep)

@@ -138,8 +138,8 @@ class SingleAgentEnvRunner:
                 import ray_tpu
 
                 key_data = (None if self._greedy
-                            else np.asarray(jax.random.key_data(sub)))
-                action_np, logp, value = ray_tpu.get(
+                            else jax.device_get(jax.random.key_data(sub)))
+                action_np, logp_np, val_np = ray_tpu.get(
                     self._inference.infer.remote(obs, key_data, self._greedy))
                 if self._greedy and self._epsilon > 0:
                     explore = self._np_rng.random(N) < self._epsilon
@@ -149,9 +149,9 @@ class SingleAgentEnvRunner:
             elif self._greedy:
                 action = self._greedy_fn(
                     self._params, jax.device_put(obs, self._device))
-                logp = jnp.zeros(N)
-                value = jnp.zeros(N)
-                action_np = np.asarray(action)
+                action_np = jax.device_get(action)  # the step's one sync
+                logp_np = np.zeros(N, np.float32)
+                val_np = np.zeros(N, np.float32)
                 if self._epsilon > 0:
                     explore = self._np_rng.random(N) < self._epsilon
                     randoms = self._np_rng.integers(
@@ -161,15 +161,17 @@ class SingleAgentEnvRunner:
                 action, logp, value = self._sample_fn(
                     self._params, jax.device_put(obs, self._device), sub
                 )
-                action_np = np.asarray(action)
+                # one batched fetch per env step instead of three syncs
+                action_np, logp_np, val_np = jax.device_get(
+                    (action, logp, value))
             env_action = action_np.astype(np.int64) if self.spec.discrete else action_np
             next_obs, reward, terminated, truncated, _ = self._envs.step(env_action)
             done = np.logical_or(terminated, truncated)
 
             obs_buf[t] = obs
             act_buf[t] = action_np
-            logp_buf[t] = np.asarray(logp)
-            val_buf[t] = np.asarray(value)
+            logp_buf[t] = logp_np
+            val_buf[t] = val_np
             rew_buf[t] = reward
             # GAE must not bootstrap across true terminations; truncations
             # keep bootstrapping (the obs recorded on the autoreset step is
@@ -200,7 +202,7 @@ class SingleAgentEnvRunner:
             out = self.module.forward_inference(
                 self._params, jax.device_put(last_obs, self._device)
             )
-            last_val = np.asarray(out["vf_preds"])
+            last_val = jax.device_get(out["vf_preds"])
 
         return {
             "obs": obs_buf,

@@ -367,7 +367,19 @@ class IMPALA:
         cfg = self.config
         t0 = time.perf_counter()
         target_fragments = max(len(self._runners), cfg.train_batch_fragments)
-        if self._use_lanes:
+        if self._iteration >= 1:
+            # Iteration 1 compiled every program (update fn, broadcast
+            # fetch); from here on the driver-side loop is steady state —
+            # a new XLA compile or an implicit device->host read is a
+            # regression (recorded when jitcheck is installed).
+            from ray_tpu.devtools import jitcheck
+
+            with jitcheck.steady_state():
+                if self._use_lanes:
+                    stats = self._train_lanes(target_fragments)
+                else:
+                    stats = self._train_tasks(target_fragments)
+        elif self._use_lanes:
             stats = self._train_lanes(target_fragments)
         else:
             stats = self._train_tasks(target_fragments)

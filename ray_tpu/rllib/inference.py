@@ -110,7 +110,7 @@ class InferenceActor:
     def values(self, obs: np.ndarray) -> np.ndarray:
         """Critic-only forward for fragment bootstrap values (one call per
         fragment — not worth the batching window)."""
-        return np.asarray(self._value_fn(
+        return jax.device_get(self._value_fn(
             self._params, jax.device_put(np.asarray(obs), self._device)))
 
     def _run_batch(self, requests: List[_Request]):
@@ -130,7 +130,7 @@ class InferenceActor:
             obs = jax.device_put(
                 np.stack([requests[i].obs for i in idxs]), self._device)
             if greedy:
-                actions = np.asarray(self._greedy_many(self._params, obs))
+                actions = jax.device_get(self._greedy_many(self._params, obs))
                 n = shape[0]
                 for j, i in enumerate(idxs):
                     results[i] = (actions[j], np.zeros(n, np.float32),
@@ -141,7 +141,8 @@ class InferenceActor:
                         jnp.asarray(requests[i].key_data))
                     for i in idxs])
                 a, logp, v = self._sample_many(self._params, obs, keys)
-                a, logp, v = np.asarray(a), np.asarray(logp), np.asarray(v)
+                # one batched fetch per dispatch group, not three syncs
+                a, logp, v = jax.device_get((a, logp, v))
                 for j, i in enumerate(idxs):
                     results[i] = (a[j], logp[j], v[j])
         return results

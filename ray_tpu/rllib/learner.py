@@ -64,10 +64,11 @@ class Learner:
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, jbatch
         )
+        metrics = jax.device_get(metrics)  # one batched fetch, not per-key
         return {k: float(v) for k, v in metrics.items()}
 
     def get_weights(self):
-        return jax.tree.map(np.asarray, self.params)
+        return jax.device_get(self.params)
 
     def set_weights(self, params) -> bool:
         self.params = jax.tree.map(

@@ -151,6 +151,9 @@ class BC:
             "lr": config.lr, "grad_clip": config.grad_clip,
             "beta": config.beta, "vf_coeff": config.vf_coeff,
         }, seed=config.seed)
+        # jitted eval forward, built lazily on the first evaluate() and
+        # cached — rebuilding jax.jit per call recompiles every time
+        self._eval_fwd = None
         # Materialize the dataset once into columnar arrays (offline
         # corpora for control tasks are small; a streaming path can batch
         # through iter_batches for bigger ones).
@@ -196,7 +199,9 @@ class BC:
         env = env_creator()
         module = self.learner.module
         params = self.learner.params
-        fwd = jax.jit(module.forward_inference)
+        if self._eval_fwd is None:
+            self._eval_fwd = jax.jit(module.forward_inference)
+        fwd = self._eval_fwd
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=seed + ep)

@@ -37,8 +37,10 @@ import time
 import weakref
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
+import jax
 import numpy as np
 
+from ray_tpu.devtools import jitcheck
 from ray_tpu.models.generate import (KVBlockManager, NoFreeBlocks,
                                      PagedGenerator, SlottedGenerator)
 from ray_tpu.models.transformer import TransformerConfig
@@ -203,6 +205,11 @@ class LLMEngine:
         self.decode_seconds = 0.0
         self.finish_reason = "stop"  # convenience; races under concurrency
 
+        # Flipped by warmup(): from then on every scheduler step runs under
+        # jitcheck.steady_state() — zero new XLA compiles, zero implicit
+        # device->host reads (enforced when jitcheck is installed).
+        self._steady = False
+
     # -- device-half hooks (the paged engine overrides these) -----------------
     # The scheduler above them — admission budget, slot bookkeeping, token
     # distribution, the streaming contract — is engine-agnostic; everything
@@ -285,6 +292,7 @@ class LLMEngine:
                 np.zeros(self.slots, bool), self._greedy, self._temps)
             np.asarray(toks)
             self._cache, self._last, self._keys = self._sg.init_state()
+            self._steady = True
 
     def _bucket_for(self, n: int) -> int:
         # One full decode chunk must fit after the prompt: decode always
@@ -468,9 +476,16 @@ class LLMEngine:
 
     # -- the iteration-level scheduler ----------------------------------------
     def _step(self) -> None:
-        # Called holding _step_lock (the elected driver).
+        # Called holding _step_lock (the elected driver). Post-warmup the
+        # step runs under the steady-state contract: any new XLA compile or
+        # implicit device->host read is a violation (recorded when jitcheck
+        # is installed; steady_state() is a no-op otherwise).
         try:
-            self._step_inner()
+            if self._steady:
+                with jitcheck.steady_state():
+                    self._step_inner()
+            else:
+                self._step_inner()
         except BaseException as err:
             self._fail_inflight(err)
             raise
@@ -560,7 +575,7 @@ class LLMEngine:
         # 3. One batched decode chunk advancing every active slot.
         t0 = time.perf_counter()
         toks = self._run_decode(active, greedy, temps, extra)
-        host_toks = np.asarray(toks)  # the step's single device sync
+        host_toks = jax.device_get(toks)  # the step's single device sync
         dt = time.perf_counter() - t0
         now = time.perf_counter()
 
@@ -881,6 +896,12 @@ class PagedLLMEngine(LLMEngine):
             np.asarray(toks)
             cf = self._pg.copy_fn()
             self._k_pool, self._v_pool = cf(self._k_pool, self._v_pool, 0, 0)
+            # The handoff attach program (set_last) runs mid-step when a
+            # prefilled request lands — compile it here, not on its TTFT.
+            sl = self._pg.set_last_fn()
+            self._last, self._keys = sl(
+                self._last, self._keys,
+                np.zeros(self._last.shape[1], np.float32), 0, 0)
             if self._tier is not None:
                 # Tier upload/download programs: compile HERE so a cold
                 # replica's first store fetch never pays XLA on its TTFT
@@ -914,6 +935,7 @@ class PagedLLMEngine(LLMEngine):
                 (self._k_pool, self._v_pool, self._kd_pool, self._vd_pool,
                  self._last, self._keys) = out[3:9]
             self._reset_device_state()
+            self._steady = True
 
     def _suffix_bucket(self, n: int) -> int:
         # The suffix prefill's compile bucket — unlike _bucket_for it needs
@@ -1199,16 +1221,19 @@ class PagedLLMEngine(LLMEngine):
             self._kd_pool, self._vd_pool, self._last, self._keys, tables,
             lengths, active, greedy, temps, spec_on, tail, pending,
             use_pending)
-        counts_np = np.asarray(counts)        # syncs the step
-        self._spec_last_dt = time.perf_counter() - t0
-        accepted_np = np.asarray(accepted)
-        self._last_counts = counts_np
-        # Carry the spec chain state back to host. Safe wholesale: only the
-        # step thread writes these between operand snapshot and here, and
+        # One batched fetch syncs the step: counts/accepted plus the spec
+        # chain state carried back to host. Safe wholesale: only the step
+        # thread writes these between operand snapshot and here, and
         # per-slot admission resets happen before the NEXT step's snapshot.
-        self._spec_tail = np.array(tail_j)
-        self._spec_pending = np.array(pending_j)
-        self._spec_use_pending = np.array(up_j)
+        (counts_np, accepted_np, tail_np, pending_np, up_np) = \
+            jax.device_get((counts, accepted, tail_j, pending_j, up_j))
+        self._spec_last_dt = time.perf_counter() - t0
+        self._last_counts = counts_np
+        # device_get views are read-only; the chain state is mutated
+        # in place by slot admission/free, so take writable copies.
+        self._spec_tail = np.array(tail_np)
+        self._spec_pending = np.array(pending_np)
+        self._spec_use_pending = np.array(up_np)
         # Acceptance EWMA feeds next step's gate: slots whose EWMA sinks
         # below the floor stop proposing for the rest of the request (their
         # draft passes would cost more than the accepted tokens buy).

@@ -66,6 +66,15 @@ from ray_tpu.devtools import leakcheck as _leakcheck  # noqa: E402
 
 _LEAKCHECK_ON = _leakcheck.maybe_install()
 
+# Opt-in runtime JAX compile-churn validation (ray_tpu.devtools.jitcheck):
+# with RAY_TPU_JIT_CHECK_ENABLED=1, jax.jit is wrapped to stamp and count
+# compilations, and the autouse fixture below FAILS any test during which
+# a steady-state contract violation (new XLA compile or implicit
+# device->host read inside jitcheck.steady_state()) was recorded.
+from ray_tpu.devtools import jitcheck as _jitcheck  # noqa: E402
+
+_JITCHECK_ON = _jitcheck.maybe_install()
+
 TEST_TIMEOUT_S = 180  # matches the reference's pytest.ini per-test timeout
 
 
@@ -115,6 +124,24 @@ def _lock_order_guard():
     yield
     new = _lockcheck.violations()[before:]
     assert not new, "lock-order violations during test:\n" + "\n".join(new)
+
+
+@pytest.fixture(autouse=True)
+def _steady_state_guard(request):
+    """With jitcheck installed, fail any test during which a steady-state
+    violation was recorded — a new XLA compile or an implicit device->host
+    read inside jitcheck.steady_state(). `@pytest.mark.jit_violations`
+    opts a test out (tests that provoke violations on purpose)."""
+    if not _JITCHECK_ON:
+        yield
+        return
+    before = len(_jitcheck.violations())
+    yield
+    if request.node.get_closest_marker("jit_violations") is not None:
+        return
+    new = _jitcheck.violations()[before:]
+    assert not new, (
+        "steady-state jit violations during test:\n  " + "\n  ".join(new))
 
 
 @pytest.fixture(autouse=True)
